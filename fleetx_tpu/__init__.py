@@ -1,0 +1,7 @@
+"""fleetx_tpu — TPU-native large-model toolkit (JAX/XLA/Pallas/pjit).
+
+Capability parity target: PaddleFleetX (see SURVEY.md). Idiomatic JAX:
+one device mesh, GSPMD sharding rules, jitted train step, Pallas kernels.
+"""
+
+__version__ = "0.1.0"

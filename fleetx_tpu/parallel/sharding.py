@@ -1,0 +1,100 @@
+"""Logical-axis sharding rules — the TPU-native replacement for the
+reference's per-strategy wrappers:
+
+- TP column/row/vocab-parallel layers (hybrid_model.py:49-174,628-680) become
+  rules mapping the ``heads``/``mlp``/``vocab`` logical axes to mesh axis
+  ``mp``; GSPMD inserts the all-reduce/all-gather that Column/RowParallelLinear
+  did by hand.
+- ZeRO sharding stages 1-3 (distributed/apis/sharding.py:30-147) become the
+  ``fsdp`` mesh axis applied to optimizer state (stage 1/2) and additionally
+  to parameters (stage 3).
+- Megatron sequence parallel (sequence_parallel_utils.py:40-395) becomes an
+  activation sharding constraint putting the ``seq`` logical axis on ``mp``;
+  XLA's collective-matmul pass emits the same all-gather/reduce-scatter
+  overlap the hand-written ScatterOp/GatherOp/ReduceScatterOp provided.
+
+Models annotate params/activations with logical axis names (flax
+``nn.with_partitioning`` / ``logical_to_mesh``); these tables translate
+logical names → mesh axes for a given parallelism configuration.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = [
+    "make_rules",
+    "logical_to_mesh_sharding",
+    "param_shardings",
+    "opt_state_shardings",
+    "with_logical_constraint",
+]
+
+Rules = Sequence[Tuple[str, Any]]
+
+
+def make_rules(
+    sharding_stage: int = 1,
+    sequence_parallel: bool = False,
+    fsdp_params: Optional[bool] = None,
+) -> List[Tuple[str, Any]]:
+    """Logical→mesh axis rules.
+
+    ``fsdp_params`` overrides whether *parameters* (not just optimizer state)
+    are sharded over the fsdp axis; default derives from sharding_stage>=3.
+    """
+    if fsdp_params is None:
+        fsdp_params = sharding_stage >= 3
+    rules: List[Tuple[str, Any]] = [
+        ("batch", ("dp", "fsdp")),
+        # TP: vocab-, column- (heads/mlp out), and row-parallel (reduced-in)
+        ("vocab", "mp"),
+        ("heads", "mp"),
+        ("kv", None),
+        ("mlp", "mp"),
+        # embed is the row-parallel contraction axis of out-proj / mlp.down and
+        # the fsdp shard axis for stage-3 param sharding.
+        ("embed", "fsdp" if fsdp_params else None),
+        ("norm", None),
+        ("layers", None),  # stacked (scan) layer axis; pp maps it to stages
+        ("stage", "pp"),
+        # expert parallelism folds over the data-parallel world (reference
+        # HybridCommGroupForMoE fuses moe = dp×mp, comm_groups.py:125-153;
+        # here experts shard over dp×fsdp and mp shards within an expert).
+        ("expert", ("dp", "fsdp")),
+        ("cache_batch", None),
+        ("cache_heads", "mp"),
+    ]
+    # Activation sequence axis: sharded over mp when sequence_parallel, over
+    # nothing otherwise. 'act_seq' only tags activations, never params.
+    rules.append(("act_seq", "mp" if sequence_parallel else None))
+    rules.append(("act_batch", ("dp", "fsdp")))
+    rules.append(("act_embed", None))
+    return rules
+
+
+def logical_to_mesh_sharding(tree, mesh: Mesh, rules: Rules):
+    """Map a pytree of logical PartitionSpecs to NamedShardings on mesh."""
+    return nn.logical_to_mesh_sharding(tree, mesh, list(rules))
+
+
+def param_shardings(abstract_vars, mesh: Mesh, rules: Rules):
+    """NamedShardings for a flax variables pytree whose params carry
+    ``nn.Partitioned`` logical-axis metadata (from nn.with_partitioning)."""
+    logical_specs = nn.get_partition_spec(abstract_vars)
+    return logical_to_mesh_sharding(logical_specs, mesh, rules)
+
+
+def opt_state_shardings(opt_state_shape, param_sharding_fn):
+    """Shardings for optax optimizer state: moment tensors mirror their
+    parameter's sharding (possibly upgraded to fsdp for ZeRO-1/2); scalars
+    replicate."""
+    raise NotImplementedError  # built alongside the trainer
+
+
+def with_logical_constraint(x, logical_axes: Tuple[Optional[str], ...]):
+    """Annotate an activation with logical axes (no-op outside a mesh ctx)."""
+    return nn.with_logical_constraint(x, P(*logical_axes))

@@ -1,0 +1,28 @@
+"""Test harness: force an 8-device virtual CPU platform so every parallelism
+strategy (dp/fsdp/mp/pp/sp/ep collectives) is exercised without a TPU —
+the unit-test pyramid the reference lacks (SURVEY.md §4)."""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the sandbox presets JAX_PLATFORMS=axon
+os.environ.setdefault("FLEETX_LOG_LEVEL", "WARNING")
+
+import jax  # noqa: E402
+
+# The sandbox's sitecustomize registers an 'axon' TPU backend and pins
+# jax_platforms to it; re-pin to the virtual 8-device CPU platform.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
