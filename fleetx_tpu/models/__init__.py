@@ -1,0 +1,52 @@
+"""Model registry: ``build_module(cfg)`` dispatches on ``cfg.Model.module``
+(reference /root/reference/ppfleetx/models/__init__.py:30-34, minus the
+eval-by-name — an explicit registry is greppable and safe)."""
+
+from __future__ import annotations
+
+_REGISTRY = {}
+
+
+def register_module(name):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def build_module(cfg):
+    name = cfg.Model.module
+    module_cls = _get(name)
+    return module_cls(cfg)
+
+
+def _get(name):
+    _populate()
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown module {name!r}; registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def _populate():
+    """Lazy imports so `import fleetx_tpu.models` stays light."""
+    if _REGISTRY:
+        return
+    from fleetx_tpu.models.language_module import GPTModule
+
+    _REGISTRY["GPTModule"] = GPTModule
+    for name, path, attr in [
+        ("GPTGenerationModule", "fleetx_tpu.models.language_module_generation", "GPTGenerationModule"),
+        ("GPTEvalModule", "fleetx_tpu.models.language_module_eval", "GPTEvalModule"),
+        ("GPTFinetuneModule", "fleetx_tpu.models.language_module_finetune", "GPTFinetuneModule"),
+        ("MoEModule", "fleetx_tpu.models.moe_module", "MoEModule"),
+        ("GeneralClsModule", "fleetx_tpu.models.vision_module", "GeneralClsModule"),
+        ("MOCOModule", "fleetx_tpu.models.moco_module", "MOCOModule"),
+        ("ErnieModule", "fleetx_tpu.models.ernie_module", "ErnieModule"),
+        ("ImagenModule", "fleetx_tpu.models.imagen_module", "ImagenModule"),
+    ]:
+        try:
+            mod = __import__(path, fromlist=[attr])
+            _REGISTRY[name] = getattr(mod, attr)
+        except ImportError:
+            pass  # family not built yet; registry reports what exists

@@ -1,0 +1,100 @@
+"""Language-model modules (reference /root/reference/ppfleetx/models/
+language_model/language_module.py:47-222).
+
+One GPTModule serves every topology — the reference's class-per-parallelism
+dispatch (GPTModel | GPTModelHybrid | GPTForPretrainingPipe picked by
+nranks/pp_degree, language_module.py:153-188) is unnecessary when sharding is
+annotation-driven.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from fleetx_tpu.models.gpt.model import (
+    GPTConfig,
+    GPTForPretraining,
+    pretraining_loss,
+)
+from fleetx_tpu.models.module import BasicModule
+from fleetx_tpu.utils.log import logger
+
+__all__ = ["LanguageModule", "GPTModule"]
+
+
+class LanguageModule(BasicModule):
+    """Adds LM-style logging: loss, lr, avg step cost, ips (tokens/s) — the
+    ``ips:`` keyword line is what the benchmark harness parses (reference
+    run_benchmark.sh:20-22)."""
+
+    def training_step_end(self, log: Dict) -> None:
+        logger.train(
+            "[train] epoch: %d, batch: %d, loss: %.9f, avg_batch_cost: %.5f sec, "
+            "speed: %.2f step/s, ips_total: %.0f tokens/s, ips: %.0f tokens/s, "
+            "learning rate: %.3e",
+            log["epoch"],
+            log["batch"],
+            log["loss"],
+            log["batch_cost"],
+            1.0 / max(log["batch_cost"], 1e-9),
+            log["ips_total"],
+            log["ips"],
+            log["lr"],
+        )
+
+    def validation_step_end(self, log: Dict) -> None:
+        logger.eval(
+            "[eval] epoch: %d, batch: %d, loss: %.9f, avg_eval_cost: %.5f sec",
+            log["epoch"],
+            log["batch"],
+            log["loss"],
+            log["batch_cost"],
+        )
+
+
+class GPTModule(LanguageModule):
+    """GPT pretraining module: batch = (tokens, position_ids, labels,
+    loss_mask)."""
+
+    def get_model(self):
+        model_cfg = self.cfg.Model if hasattr(self.cfg, "Model") else self.cfg
+        gcfg = GPTConfig.from_model_config(model_cfg)
+        eng = getattr(self.cfg, "Engine", None) or {}
+        mp = (eng.get("mix_precision") or {}) if isinstance(eng, dict) else {}
+        # Compute dtype from the AMP config. fp16 maps to bf16: TPU-native
+        # mixed precision needs no loss scaling (reference GradScaler + O2
+        # decorate, eager_engine.py:162-172, has no TPU equivalent to need).
+        name = mp.get("dtype") or ("bfloat16" if mp.get("use_pure_fp16") else "float32")
+        dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.bfloat16,
+                 "float32": jnp.float32}[str(name)]
+        gcfg = GPTConfig(**{**gcfg.__dict__, "dtype": dtype})
+        self.gpt_config = gcfg
+        return GPTForPretraining(gcfg)
+
+    def init_params(self, rng, batch):
+        tokens = batch["tokens"]
+        return self.nets.init(rng, tokens)
+
+    def loss_fn(self, params, batch, rng, train: bool):
+        logits = self.nets.apply(
+            {"params": params},
+            batch["tokens"],
+            batch.get("position_ids"),
+            deterministic=not train,
+            rngs={"dropout": rng} if train and rng is not None else None,
+        )
+        loss = pretraining_loss(logits, batch["labels"], batch["loss_mask"])
+        return loss, {}
+
+    def input_spec(self):
+        glb = self.cfg.Global
+        seq = self.cfg.Data.Train.dataset.max_seq_len if self.cfg.Data else 1024
+        b = glb.micro_batch_size or 1
+        return {
+            "tokens": jax.ShapeDtypeStruct((b, seq), jnp.int32),
+            "position_ids": jax.ShapeDtypeStruct((b, seq), jnp.int32),
+        }

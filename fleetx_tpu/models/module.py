@@ -1,0 +1,57 @@
+"""Module contract — the Lightning-style boundary between engine and model
+(reference BasicModule, /root/reference/ppfleetx/core/module/basic_module.py:
+29-86). JAX twist: steps are pure functions of (params, batch, rng) returning
+(loss, metrics) so the engine can jit/shard them; the module owns model
+construction, loss, and batch pre/post hooks, not the training loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+__all__ = ["BasicModule"]
+
+
+class BasicModule:
+    """Subclasses provide the model + loss; the Trainer owns jit/sharding."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.nets = self.get_model()
+
+    # --- construction -----------------------------------------------------
+    def get_model(self):
+        raise NotImplementedError
+
+    def init_params(self, rng: jax.Array, batch) -> Any:
+        """Initialize (possibly abstractly, under jax.eval_shape) params."""
+        raise NotImplementedError
+
+    # --- steps (pure; engine jits them) ----------------------------------
+    def loss_fn(
+        self, params, batch, rng: Optional[jax.Array], train: bool
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        """Return (scalar loss, aux metrics dict)."""
+        raise NotImplementedError
+
+    def eval_metrics(self, params, batch) -> Dict[str, jax.Array]:
+        loss, metrics = self.loss_fn(params, batch, None, train=False)
+        return {"loss": loss, **metrics}
+
+    # --- hooks ------------------------------------------------------------
+    def pretreating_batch(self, batch):
+        """Host-side batch re-pack hook (reference PP repacking,
+        language_module.py:198-204)."""
+        return batch
+
+    def training_step_end(self, log: Dict[str, Any]) -> None:
+        pass
+
+    def validation_step_end(self, log: Dict[str, Any]) -> None:
+        pass
+
+    def input_spec(self):
+        """Abstract (shape, dtype) spec of one device batch, for export."""
+        return None

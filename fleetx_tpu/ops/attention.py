@@ -1,0 +1,102 @@
+"""Core attention ops.
+
+Replaces the reference's ``core_attn`` + CUDA ``softmax_mask_fuse_upper_
+triangle`` (/root/reference/ppfleetx/models/language_model/gpt/dygraph/
+single_model.py:216-240): on TPU the causal-masked softmax is either fused by
+XLA from this straight-line jnp implementation or dispatched to the Pallas
+flash-attention kernel (fleetx_tpu/ops/pallas/flash_attention.py) which never
+materializes the [b, heads, s, s] score matrix — that memory saving is what
+lets long-context configs run without the reference's recompute tricks.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["causal_attention", "NEG_INF"]
+
+NEG_INF = -1e9  # large-but-finite; -inf breaks softmax when a row is all-masked
+
+
+def _reference_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool,
+    attn_mask: Optional[jax.Array],
+    dropout_rate: float,
+    dropout_rng: Optional[jax.Array],
+    deterministic: bool,
+) -> jax.Array:
+    """Plain XLA attention. Shapes: q,k,v [batch, seq, heads, head_dim]
+    (kv seq may differ from q seq for cached decode)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
+    # [b, h, sq, sk]; accumulate scores in fp32 for softmax stability.
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if causal:
+        # offset aligns the last q position with the last k position so the
+        # same code serves full-sequence and incremental-decode calls.
+        q_pos = jnp.arange(sq)[:, None] + (sk - sq)
+        k_pos = jnp.arange(sk)[None, :]
+        scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+    if attn_mask is not None:
+        # mask: 1 = attend, 0 = hide; broadcastable to [b, h, sq, sk]
+        scores = jnp.where(attn_mask.astype(bool), scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0 and not deterministic:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    attn_mask: Optional[jax.Array] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    deterministic: bool = True,
+    use_flash: bool = True,
+) -> jax.Array:
+    """Multi-head scaled-dot-product attention, [b, s, h, d] layout.
+
+    Routes to the Pallas flash kernel when profitable (TPU, no attention
+    dropout, no custom mask, train-time shapes); falls back to the XLA path
+    otherwise. Both paths produce identical math (kernel is tested against
+    this reference implementation).
+    """
+    can_flash = (
+        use_flash
+        and causal
+        and attn_mask is None
+        and (dropout_rate == 0.0 or deterministic)
+        and q.shape[1] == k.shape[1]  # not incremental decode
+        and q.shape[1] >= 128  # kernel block size
+        and jax.default_backend() in ("tpu", "axon")
+    )
+    if can_flash:
+        from fleetx_tpu.ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(q, k, v)
+    return _reference_attention(
+        q,
+        k,
+        v,
+        causal=causal,
+        attn_mask=attn_mask,
+        dropout_rate=dropout_rate,
+        dropout_rng=dropout_rng,
+        deterministic=deterministic,
+    )

@@ -1,0 +1,141 @@
+"""LR schedules (reference /root/reference/ppfleetx/optims/lr_scheduler.py:
+31-160) as optax schedule functions."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import optax
+
+__all__ = [
+    "CosineAnnealingWithWarmupDecay",
+    "LinearDecayWithWarmup",
+    "ViTLRScheduler",
+    "MultiStepDecay",
+    "CosineDecay",
+    "build_lr_scheduler",
+]
+
+
+def CosineAnnealingWithWarmupDecay(
+    max_lr: float,
+    min_lr: float = 0.0,
+    warmup_rate: float = 0.01,
+    decay_steps: int = 360000,
+    warmup_steps: Optional[int] = None,
+    **_,
+) -> optax.Schedule:
+    """Megatron schedule: linear warmup to max_lr over warmup_rate*decay_steps,
+    cosine decay to min_lr at decay_steps, constant min_lr after."""
+    if warmup_steps is None:
+        warmup_steps = int(warmup_rate * decay_steps)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = max_lr * step / jnp.maximum(warmup_steps, 1)
+        frac = jnp.clip(
+            (step - warmup_steps) / jnp.maximum(decay_steps - warmup_steps, 1), 0.0, 1.0
+        )
+        cos = min_lr + 0.5 * (max_lr - min_lr) * (1.0 + jnp.cos(jnp.pi * frac))
+        return jnp.where(step < warmup_steps, warm, cos)
+
+    return schedule
+
+
+def LinearDecayWithWarmup(
+    learning_rate: float = None,
+    max_lr: float = None,
+    total_steps: int = None,
+    warmup: float = 0.1,
+    **_,
+) -> optax.Schedule:
+    """Linear warmup (fraction ``warmup`` of total) then linear decay to 0."""
+    lr = max_lr if learning_rate is None else learning_rate
+    warmup_steps = int(warmup * total_steps) if warmup < 1 else int(warmup)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = lr * step / jnp.maximum(warmup_steps, 1)
+        decay = lr * jnp.clip(
+            (total_steps - step) / jnp.maximum(total_steps - warmup_steps, 1), 0.0, 1.0
+        )
+        return jnp.where(step < warmup_steps, warm, decay)
+
+    return schedule
+
+
+def ViTLRScheduler(
+    learning_rate: float,
+    epochs: int,
+    step_each_epoch: int,
+    warmup_epochs: int = 0,
+    decay_type: str = "cosine",
+    **_,
+) -> optax.Schedule:
+    total = epochs * step_each_epoch
+    warmup_steps = warmup_epochs * step_each_epoch
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = learning_rate * step / jnp.maximum(warmup_steps, 1)
+        frac = jnp.clip((step - warmup_steps) / jnp.maximum(total - warmup_steps, 1), 0.0, 1.0)
+        if decay_type == "cosine":
+            dec = 0.5 * learning_rate * (1.0 + jnp.cos(jnp.pi * frac))
+        else:
+            dec = learning_rate * (1.0 - frac)
+        return jnp.where(step < warmup_steps, warm, dec)
+
+    return schedule
+
+
+def MultiStepDecay(
+    learning_rate: float,
+    milestones: Sequence[int],
+    gamma: float = 0.1,
+    **_,
+) -> optax.Schedule:
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        exponent = jnp.sum(
+            jnp.asarray([step >= m for m in milestones], jnp.float32)
+        )
+        return learning_rate * gamma**exponent
+
+    return schedule
+
+
+def CosineDecay(
+    learning_rate: float,
+    decay_steps: int,
+    alpha: float = 0.0,
+    **_,
+) -> optax.Schedule:
+    def schedule(step):
+        frac = jnp.clip(jnp.asarray(step, jnp.float32) / decay_steps, 0.0, 1.0)
+        coeff = 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        return learning_rate * ((1 - alpha) * coeff + alpha)
+
+    return schedule
+
+
+_SCHEDULES = {
+    "CosineAnnealingWithWarmupDecay": CosineAnnealingWithWarmupDecay,
+    "LinearDecayWithWarmup": LinearDecayWithWarmup,
+    "ViTLRScheduler": ViTLRScheduler,
+    "MultiStepDecay": MultiStepDecay,
+    "CosineDecay": CosineDecay,
+}
+
+
+def build_lr_scheduler(lr_cfg) -> optax.Schedule:
+    """Build from config (reference optims/__init__.py:29-42). A bare float
+    'lr' config becomes a constant schedule."""
+    if isinstance(lr_cfg, (int, float)):
+        return optax.constant_schedule(float(lr_cfg))
+    cfg = dict(lr_cfg)
+    name = cfg.pop("name", "CosineAnnealingWithWarmupDecay")
+    if name not in _SCHEDULES:
+        raise ValueError(f"unknown lr scheduler {name!r}; have {sorted(_SCHEDULES)}")
+    return _SCHEDULES[name](**cfg)

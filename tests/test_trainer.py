@@ -1,0 +1,179 @@
+"""End-to-end Trainer tests on the 8-device CPU mesh: loss goes down under
+dp/mp/fsdp sharding, grad accumulation matches the big-batch step, and
+checkpoint save/load resumes exactly."""
+
+import numpy as np
+import pytest
+
+from fleetx_tpu.core.engine import Trainer
+from fleetx_tpu.models import build_module
+from fleetx_tpu.utils.config import AttrDict, get_config
+import textwrap
+
+
+def _cfg(tmp_path, nranks=8, **over):
+    text = textwrap.dedent(
+        """
+        Global:
+          seed: 42
+          local_batch_size: 4
+          micro_batch_size: 4
+        Engine:
+          max_steps: 8
+          logging_freq: 4
+          eval_freq: 0
+          eval_iters: 2
+          save_load:
+            save_steps: 1000
+        Model:
+          module: GPTModule
+          vocab_size: 128
+          hidden_size: 64
+          num_layers: 2
+          num_attention_heads: 4
+          ffn_hidden_size: 128
+          max_position_embeddings: 32
+          hidden_dropout_prob: 0.0
+          attention_probs_dropout_prob: 0.0
+          use_flash_attention: False
+        Optimizer:
+          name: AdamW
+          weight_decay: 0.01
+          lr:
+            name: CosineAnnealingWithWarmupDecay
+            decay_steps: 100
+            max_lr: 1.0e-3
+            min_lr: 1.0e-4
+          grad_clip:
+            name: ClipGradByGlobalNorm
+            clip_norm: 1.0
+        Distributed:
+          dp_degree: 2
+          mp_degree: 2
+          pp_degree: 1
+          sharding:
+            sharding_degree: 2
+            sharding_stage: 2
+        """
+    )
+    p = tmp_path / "cfg.yaml"
+    p.write_text(text)
+    cfg = get_config(str(p), overrides=[f"{k}={v}" for k, v in over.items()], nranks=nranks)
+    cfg.Engine.save_load.output_dir = str(tmp_path / "output")
+    return cfg
+
+
+def _batches(cfg, n, seq=32, seed=0):
+    """Synthetic LM data with a learnable pattern (next token = +1 mod V)."""
+    rng = np.random.RandomState(seed)
+    gbs = cfg.Global.global_batch_size
+    vocab = cfg.Model.vocab_size
+    out = []
+    for _ in range(n):
+        start = rng.randint(0, vocab, (gbs, 1))
+        tokens = (start + np.arange(seq)[None, :]) % vocab
+        labels = (tokens + 1) % vocab
+        out.append(
+            {
+                "tokens": tokens.astype(np.int32),
+                "labels": labels.astype(np.int32),
+                "loss_mask": np.ones((gbs, seq), np.float32),
+            }
+        )
+    return out
+
+
+def test_fit_loss_decreases(tmp_path, eight_devices):
+    cfg = _cfg(tmp_path)
+    module = build_module(cfg)
+    trainer = Trainer(cfg, module)
+    data = _batches(cfg, 8)
+    trainer.init_state(data[0])
+    losses = []
+
+    step_fn = trainer._get("train", trainer._build_train_step)
+    import fleetx_tpu.parallel.env as dist_env
+
+    for i, b in enumerate(data):
+        db = trainer._shard_batch(b)
+        trainer.state, m = step_fn(trainer.state, db, dist_env.data_rank_key(i))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+def test_fit_api_and_eval(tmp_path, eight_devices, capsys):
+    cfg = _cfg(tmp_path)
+    module = build_module(cfg)
+    trainer = Trainer(cfg, module)
+    data = _batches(cfg, 8)
+    trainer.fit(data, valid_data=data[:2])
+    assert int(trainer.state.step) == 8
+    loss = trainer.evaluate(data[:2])
+    assert np.isfinite(loss)
+
+
+def test_grad_accumulation_matches_big_batch(tmp_path, eight_devices):
+    """Accumulated grads (accum=2, micro=2) must equal the one-shot grads
+    (accum=1, micro=4) on the same data. Compared pre-optimizer: Adam's
+    sign-sensitivity would amplify benign reduction-order noise."""
+    import jax
+    from fleetx_tpu.core.engine import make_grad_fn, _unbox
+
+    cfg1 = _cfg(tmp_path)
+    cfg2 = _cfg(tmp_path)
+    cfg2.Global.micro_batch_size = 2
+    cfg2.Engine.accumulate_steps = 2
+    data = _batches(cfg1, 1)
+
+    def run(cfg):
+        module = build_module(cfg)
+        tr = Trainer(cfg, module)
+        tr.init_state(data[0])
+        fn = tr._in_context(jax.jit(make_grad_fn(module, tr.accumulate_steps)))
+        db = tr._shard_batch(data[0])
+        loss, grads = fn(tr.state.params, db, jax.random.PRNGKey(0))
+        return float(loss), jax.tree.map(np.asarray, _unbox(grads))
+
+    l1, g1 = run(cfg1)
+    l2, g2 = run(cfg2)
+    assert l1 == pytest.approx(l2, rel=1e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-6)
+
+
+def test_save_load_resume(tmp_path, eight_devices):
+    import jax
+
+    cfg = _cfg(tmp_path)
+    module = build_module(cfg)
+    trainer = Trainer(cfg, module)
+    data = _batches(cfg, 4)
+    trainer.fit(data)
+    trainer.save(epoch=0)
+    step_before = int(trainer.state.step)
+
+    # fresh trainer restores
+    module2 = build_module(cfg)
+    trainer2 = Trainer(cfg, module2)
+    trainer2.init_state(data[0])
+    assert trainer2.load()
+    assert int(trainer2.state.step) == step_before
+    from fleetx_tpu.core.engine import _unbox
+
+    for a, b in zip(
+        jax.tree.leaves(jax.tree.map(np.asarray, _unbox(trainer.state.params))),
+        jax.tree.leaves(jax.tree.map(np.asarray, _unbox(trainer2.state.params))),
+    ):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("stage", [1, 3])
+def test_sharding_stages_run(tmp_path, eight_devices, stage):
+    cfg = _cfg(tmp_path)
+    cfg.Distributed.sharding.sharding_stage = stage
+    module = build_module(cfg)
+    trainer = Trainer(cfg, module)
+    data = _batches(cfg, 2)
+    trainer.fit(data)
+    assert int(trainer.state.step) == 2
