@@ -1,0 +1,121 @@
+"""Benchmark: GPT-345M pretraining throughput on the available chip(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/s", "vs_baseline": N/16260}
+
+Baseline: the reference's GPT-345M single-card number — ~16,260 tokens/s on
+one A100-40G (BASELINE.md row 2, projects/gpt/docs/single_card.md:41-49).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+BASELINE_TOKENS_PER_SEC = 16260.0  # A100-40G, reference single_card.md
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from fleetx_tpu.core.engine import Trainer
+    from fleetx_tpu.models import build_module
+    from fleetx_tpu.utils.config import AttrDict, process_configs
+    import fleetx_tpu.parallel.env as dist_env
+
+    seq = int(os.environ.get("BENCH_SEQ", 1024))
+    batch = int(os.environ.get("BENCH_BATCH", 8))
+    steps = int(os.environ.get("BENCH_STEPS", 10))
+    warmup = int(os.environ.get("BENCH_WARMUP", 3))
+
+    cfg = AttrDict(
+        Global=AttrDict(seed=0, local_batch_size=batch, micro_batch_size=batch),
+        Engine=AttrDict(
+            max_steps=steps,
+            logging_freq=10**9,
+            mix_precision=AttrDict(use_pure_fp16=True),
+            save_load=AttrDict(save_steps=10**9, output_dir="/tmp/fleetx_bench"),
+        ),
+        Model=AttrDict(
+            module="GPTModule",
+            vocab_size=50304,
+            hidden_size=1024,
+            num_layers=24,
+            num_attention_heads=16,
+            ffn_hidden_size=4096,
+            max_position_embeddings=seq,
+            hidden_dropout_prob=0.1,
+            attention_probs_dropout_prob=0.1,
+            fuse_attn_qkv=True,
+            use_flash_attention=True,
+            # one v5e chip has 16G HBM vs the baseline A100's 40G: remat the
+            # layer stack to fit the same batch
+            use_recompute=os.environ.get("BENCH_RECOMPUTE", "1") == "1",
+            recompute_granularity="full",
+        ),
+        Optimizer=AttrDict(
+            name="FusedAdamW",
+            weight_decay=0.01,
+            lr=AttrDict(name="CosineAnnealingWithWarmupDecay", decay_steps=360000,
+                        max_lr=5e-5, min_lr=1e-5),
+            grad_clip=AttrDict(name="ClipGradByGlobalNorm", clip_norm=1.0),
+        ),
+        Distributed=AttrDict(dp_degree=None, mp_degree=1, pp_degree=1),
+    )
+    n = jax.device_count()
+    process_configs(cfg, nranks=n)
+
+    module = build_module(cfg)
+    trainer = Trainer(cfg, module)
+    gbs = cfg.Global.global_batch_size
+    rng = np.random.RandomState(0)
+    host_batch = {
+        "tokens": rng.randint(0, 50304, (gbs, seq)).astype(np.int32),
+        "labels": rng.randint(0, 50304, (gbs, seq)).astype(np.int32),
+        "loss_mask": np.ones((gbs, seq), np.float32),
+    }
+    trainer.init_state(host_batch)
+    step_fn = trainer._get("train", trainer._build_train_step)
+    db = trainer._shard_batch(host_batch)
+
+    state = trainer.state
+    for i in range(warmup):
+        state, metrics = step_fn(state, db, dist_env.data_rank_key(i))
+    float(jax.device_get(metrics["loss"]))  # host transfer = hard sync
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = step_fn(state, db, dist_env.data_rank_key(warmup + i))
+    final_loss = float(jax.device_get(metrics["loss"]))  # hard sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = gbs * seq * steps / dt
+    n_chips = jax.device_count()
+    print(
+        json.dumps(
+            {
+                "metric": "gpt_345m_pretrain_throughput",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 4),
+                "detail": {
+                    "chips": n_chips,
+                    "global_batch": gbs,
+                    "seq_len": seq,
+                    "steps": steps,
+                    "step_time_s": round(dt / steps, 4),
+                    "loss": round(final_loss, 4),
+                    "baseline": "A100-40G 16260 tokens/s (reference single_card.md)",
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -290,19 +290,29 @@ class Trainer:
         return glb.micro_batch_size * dp_world
 
     def _shard_batch(self, batch, for_train=True):
-        """Host batch [global_bs, ...] -> device arrays. With grad accum the
-        leading axis becomes [accum, micro_total] and the scan runs over it."""
+        """Host batch -> device arrays. With grad accum the leading axis
+        becomes [accum, micro_total] and the in-jit scan runs over it.
+
+        Single-host feeds the full global batch; multi-host processes each
+        feed their contiguous slice (the sampler already sliced it) and the
+        global array is assembled per-shard."""
         accum = self.accumulate_steps if for_train else 1
         micro_total = self._micro_total()
+        n_proc = jax.process_count()
         out = {}
         for k, v in batch.items():
             arr = np.asarray(v)
             if accum > 1:
-                arr = arr.reshape((accum, micro_total) + arr.shape[1:])
+                # local rows = micro_total/n_proc per microbatch on this host
+                arr = arr.reshape((accum, arr.shape[0] // accum) + arr.shape[1:])
                 spec = P(None, DATA_AXES)
             else:
                 spec = P(DATA_AXES)
-            out[k] = jax.device_put(arr, NamedSharding(self.mesh, spec))
+            sharding = NamedSharding(self.mesh, spec)
+            if n_proc > 1:
+                out[k] = jax.make_array_from_process_local_data(sharding, arr)
+            else:
+                out[k] = jax.device_put(arr, sharding)
         return out
 
     # -------------------------------------------------------------------- fit
@@ -318,6 +328,9 @@ class Trainer:
         tokens_per_batch = None
         self._profiler_maybe_start(step)
         for epoch in range(self.start_epoch, epochs):
+            sampler = getattr(train_data, "batch_sampler", None)
+            if sampler is not None and hasattr(sampler, "set_epoch"):
+                sampler.set_epoch(epoch)
             t_last = time.time()
             loss_window = []
             for batch in train_data:
@@ -360,6 +373,7 @@ class Trainer:
             if step >= self.max_steps:
                 break
         self._profiler_maybe_stop()
+        self.wait_for_checkpoints()
 
     # ------------------------------------------------------------------- eval
     def evaluate(self, valid_data: Iterable, epoch: int = 0):
@@ -395,6 +409,8 @@ class Trainer:
         import orbax.checkpoint as ocp
 
         if self._ckpt_mgr is None:
+            import atexit
+
             path = os.path.abspath(os.path.join(self.output_dir, "checkpoints"))
             os.makedirs(path, exist_ok=True)
             self._ckpt_mgr = ocp.CheckpointManager(
@@ -403,7 +419,19 @@ class Trainer:
                     max_to_keep=3, create=True, enable_async_checkpointing=True
                 ),
             )
+            # async saves must finalize before interpreter teardown or the
+            # checkpoint stays a *.orbax-checkpoint-tmp and is unloadable.
+            # weakref so atexit doesn't pin the Trainer (and its device
+            # arrays) alive for the process lifetime.
+            import weakref
+
+            ref = weakref.ref(self)
+            atexit.register(lambda: ref() and ref().wait_for_checkpoints())
         return self._ckpt_mgr
+
+    def wait_for_checkpoints(self):
+        if self._ckpt_mgr is not None:
+            self._ckpt_mgr.wait_until_finished()
 
     def save(self, epoch: int = 0):
         """Sharded save of {params, opt_state, step} + meta (epoch,

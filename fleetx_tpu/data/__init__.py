@@ -1,0 +1,77 @@
+"""Data builders from config (reference /root/reference/ppfleetx/data/
+__init__.py:28-107): ``build_dataset(cfg_section, mode)`` and
+``build_dataloader(cfg, mode)`` resolve dataset/sampler/loader classes by
+name from the YAML schema."""
+
+from __future__ import annotations
+
+_DATASETS = {}
+_BUILTINS_LOADED = False
+
+
+def register_dataset(name):
+    def deco(cls):
+        _DATASETS[name] = cls
+        return cls
+
+    return deco
+
+
+def _dataset_registry():
+    global _BUILTINS_LOADED
+    if _BUILTINS_LOADED:
+        return _DATASETS
+    from fleetx_tpu.data.gpt_dataset import GPTDataset, LMEvalDataset, LambadaEvalDataset
+
+    _DATASETS.setdefault("GPTDataset", GPTDataset)
+    _DATASETS.setdefault("LM_Eval_Dataset", LMEvalDataset)
+    _DATASETS.setdefault("LMEvalDataset", LMEvalDataset)
+    _DATASETS.setdefault("Lambada_Eval_Dataset", LambadaEvalDataset)
+    _DATASETS.setdefault("LambadaEvalDataset", LambadaEvalDataset)
+    _BUILTINS_LOADED = True
+    return _DATASETS
+
+
+def build_dataset(ds_cfg, mode: str = "Train", **extra):
+    registry = _dataset_registry()
+    kwargs = dict(ds_cfg)
+    name = kwargs.pop("name")
+    if name not in registry:
+        raise ValueError(f"unknown dataset {name!r}; have {sorted(registry)}")
+    kwargs.update(extra)
+    return registry[name](mode=mode, **kwargs)
+
+
+def build_dataloader(cfg, mode: str = "Train", consumed_samples: int = 0):
+    """Full loader from the config's Data.{Train,Eval,Test} section. Yields
+    GLOBAL batches (engine shards them onto the mesh)."""
+    from fleetx_tpu.data.dataloader import DataLoader, default_collate_fn
+    from fleetx_tpu.data.sampler import GPTBatchSampler
+
+    section = cfg.Data[mode]
+    dataset = build_dataset(section.dataset, mode=mode, seed=cfg.Global.seed)
+
+    sampler_cfg = dict(section.get("sampler") or {})
+    sampler_cfg.pop("name", None)
+    try:
+        import jax
+
+        pidx, pcount = jax.process_index(), jax.process_count()
+    except Exception:
+        pidx, pcount = 0, 1
+    sampler = GPTBatchSampler(
+        dataset_len=len(dataset),
+        batch_size=cfg.Global.global_batch_size,
+        consumed_samples=consumed_samples,
+        seed=cfg.Global.seed,
+        process_index=pidx,
+        process_count=pcount,
+        **sampler_cfg,
+    )
+    loader_cfg = dict(section.get("loader") or {})
+    return DataLoader(
+        dataset,
+        sampler,
+        collate_fn=default_collate_fn,
+        num_workers=loader_cfg.get("num_workers", 0),
+    )

@@ -1,0 +1,127 @@
+"""ctypes loader for the native index-map helpers, with a pure-numpy
+fallback when no C++ toolchain is available.
+
+Build contract mirrors the reference (gpt_dataset.py:56-69 + data_tools/cpp/
+compile.py): first process to need it compiles the .so next to the source;
+other processes wait on the file.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import time
+
+import numpy as np
+
+from fleetx_tpu.utils.log import logger
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libindex_helpers.so")
+_LIB = None
+
+
+def _ensure_built(timeout_s: float = 120.0):
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    if not os.path.isfile(_SO):
+        lock = _SO + ".building"
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+            try:
+                logger.info("compiling native index helpers...")
+                subprocess.run(["make", "-C", _HERE], check=True, capture_output=True)
+            finally:
+                os.unlink(lock)
+        except FileExistsError:
+            deadline = time.time() + timeout_s
+            while not os.path.isfile(_SO):
+                if time.time() > deadline:
+                    raise TimeoutError("timed out waiting for index helper build")
+                time.sleep(0.5)
+    lib = ctypes.CDLL(_SO)
+    lib.build_sample_idx.argtypes = [
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+    ]
+    lib.build_blending_indices.argtypes = [
+        np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        ctypes.c_int32,
+        ctypes.c_int64,
+    ]
+    _LIB = lib
+    return lib
+
+
+def build_sample_idx(sizes, doc_idx, seq_length, num_epochs, tokens_per_epoch):
+    """[num_samples+1, 2] int64 (doc_idx position, token offset) pairs."""
+    num_samples = (num_epochs * tokens_per_epoch - 1) // seq_length
+    out = np.empty((num_samples + 1, 2), dtype=np.int64)
+    try:
+        lib = _ensure_built()
+    except Exception as e:  # no toolchain: numpy fallback
+        logger.warning("native index helper unavailable (%s); using numpy", e)
+        return _build_sample_idx_np(
+            sizes, doc_idx, seq_length, num_epochs, tokens_per_epoch, num_samples
+        )
+    lib.build_sample_idx(
+        np.ascontiguousarray(sizes, np.int32),
+        np.ascontiguousarray(doc_idx, np.int32),
+        seq_length,
+        num_epochs,
+        tokens_per_epoch,
+        num_samples,
+        out.reshape(-1),
+    )
+    return out
+
+
+def _build_sample_idx_np(sizes, doc_idx, seq_length, num_epochs,
+                         tokens_per_epoch, num_samples):
+    out = np.empty((num_samples + 1, 2), dtype=np.int64)
+    di, off = 0, 0
+    out[0] = (di, off)
+    for s in range(1, num_samples + 1):
+        remaining = seq_length + 1
+        while remaining != 0:
+            doc_len = sizes[doc_idx[di]] - off
+            remaining -= doc_len
+            if remaining <= 0:
+                off += remaining + doc_len - 1
+                remaining = 0
+            else:
+                di += 1
+                off = 0
+        out[s] = (di, off)
+    return out
+
+
+def build_blending_indices(weights, size):
+    """(dataset_index uint8[size], dataset_sample_index int64[size])."""
+    weights = np.ascontiguousarray(weights, np.float64)
+    ds_index = np.empty(size, np.uint8)
+    ds_sample = np.empty(size, np.int64)
+    try:
+        lib = _ensure_built()
+        lib.build_blending_indices(ds_index, ds_sample, weights, len(weights), size)
+        return ds_index, ds_sample
+    except Exception:
+        current = np.zeros(len(weights), np.int64)
+        for i in range(size):
+            denom = max(float(i), 1.0)
+            errors = weights * denom - current
+            pick = int(np.argmax(errors))
+            ds_index[i] = pick
+            ds_sample[i] = current[pick]
+            current[pick] += 1
+        return ds_index, ds_sample

@@ -1,0 +1,80 @@
+// Native index-map builders for the Megatron-style mmap token dataset.
+//
+// TPU-native reimplementation of the reference's pybind11 extension
+// (/root/reference/ppfleetx/data/data_tools/cpp/fast_index_map_helpers.cpp:
+// build_sample_idx two-pointer construction, build_blending_indices
+// error-minimizing dataset interleave). Exposed through a plain C ABI and
+// loaded with ctypes (no pybind11 in this image); compiled on first use by
+// process 0 (reference compile-on-rank-0 contract, gpt_dataset.py:58-69).
+//
+// All buffers are caller-allocated numpy arrays; int32 doc ids / int64
+// offsets match the .npy cache format the Python side writes.
+
+#include <algorithm>
+#include <cstdint>
+
+extern "C" {
+
+// sample_idx out: [(num_samples+1) * 2] int64 pairs (doc_idx index, offset).
+// Walks the flattened doc stream epoch by epoch, emitting one entry per
+// seq_length tokens consumed (+1 shared boundary token per sample).
+void build_sample_idx(const int32_t *sizes, const int32_t *doc_idx,
+                      int32_t seq_length, int32_t num_epochs,
+                      int64_t tokens_per_epoch, int64_t num_samples,
+                      int64_t *sample_idx_out) {
+  int64_t sample_index = 0;
+  int64_t doc_idx_index = 0;
+  int32_t doc_offset = 0;
+
+  sample_idx_out[0] = doc_idx_index;
+  sample_idx_out[1] = doc_offset;
+  ++sample_index;
+
+  while (sample_index <= num_samples) {
+    int32_t remaining_seq_length = seq_length + 1;
+    while (remaining_seq_length != 0) {
+      const int32_t doc_id = doc_idx[doc_idx_index];
+      const int32_t doc_length = sizes[doc_id] - doc_offset;
+      remaining_seq_length -= doc_length;
+      if (remaining_seq_length <= 0) {
+        // sample ends inside this doc; next sample re-reads the boundary
+        // token (the -1), matching the reference construction
+        doc_offset += remaining_seq_length + doc_length - 1;
+        remaining_seq_length = 0;
+      } else {
+        ++doc_idx_index;
+        doc_offset = 0;
+      }
+    }
+    sample_idx_out[2 * sample_index] = doc_idx_index;
+    sample_idx_out[2 * sample_index + 1] = doc_offset;
+    ++sample_index;
+  }
+}
+
+// Blend multiple datasets to target weights by always taking the dataset
+// with the largest sampling deficit.
+void build_blending_indices(uint8_t *dataset_index_out,
+                            int64_t *dataset_sample_index_out,
+                            const double *weights, int32_t num_datasets,
+                            int64_t size) {
+  int64_t *current = new int64_t[num_datasets]();
+  for (int64_t i = 0; i < size; ++i) {
+    const double denom = std::max(static_cast<double>(i), 1.0);
+    int32_t pick = 0;
+    double max_error = weights[0] * denom - static_cast<double>(current[0]);
+    for (int32_t d = 1; d < num_datasets; ++d) {
+      const double err = weights[d] * denom - static_cast<double>(current[d]);
+      if (err > max_error) {
+        max_error = err;
+        pick = d;
+      }
+    }
+    dataset_index_out[i] = static_cast<uint8_t>(pick);
+    dataset_sample_index_out[i] = current[pick];
+    ++current[pick];
+  }
+  delete[] current;
+}
+
+}  // extern "C"

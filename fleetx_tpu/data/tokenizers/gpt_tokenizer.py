@@ -1,0 +1,121 @@
+"""GPT-2 byte-level BPE tokenizer (reference /root/reference/ppfleetx/data/
+tokenizers/gpt_tokenizer.py:91 — same algorithm family as every GPT-2
+implementation; this one is written against the published BPE scheme).
+
+Loads local ``vocab.json`` + ``merges.txt`` (zero-egress environment: no
+download path; pass explicit file paths or set FLEETX_VOCAB_DIR).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["GPTTokenizer"]
+
+try:
+    import regex as _re
+except ImportError:  # pragma: no cover
+    import re as _re
+
+# GPT-2's split pattern: contractions, letter runs, number runs, other, spaces
+_PAT = _re.compile(
+    r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"""
+    if _re.__name__ == "regex"
+    else r"""'s|'t|'re|'ve|'m|'ll|'d| ?[A-Za-z]+| ?[0-9]+| ?[^\sA-Za-z0-9]+|\s+(?!\S)|\s+"""
+)
+
+
+@functools.lru_cache(None)
+def _bytes_to_unicode() -> Dict[int, str]:
+    """GPT-2's reversible byte→printable-unicode map."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("¡"), ord("¬") + 1))
+        + list(range(ord("®"), ord("ÿ") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+class GPTTokenizer:
+    eos_token = "<|endoftext|>"
+
+    def __init__(self, vocab_file: str, merges_file: str, errors: str = "replace"):
+        with open(vocab_file, encoding="utf-8") as f:
+            self.encoder: Dict[str, int] = json.load(f)
+        self.decoder = {v: k for k, v in self.encoder.items()}
+        with open(merges_file, encoding="utf-8") as f:
+            lines = f.read().split("\n")
+        merges = [tuple(l.split()) for l in lines if l and not l.startswith("#version")]
+        self.bpe_ranks = dict(zip(merges, range(len(merges))))
+        self.byte_encoder = _bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+        self.errors = errors
+        self.cache: Dict[str, str] = {}
+        self.eos_token_id = self.encoder.get(self.eos_token, len(self.encoder) - 1)
+        self.eod_token_id = self.eos_token_id  # Megatron naming
+        self.pad_token_id = self.eos_token_id
+
+    @classmethod
+    def from_pretrained(cls, path: Optional[str] = None) -> "GPTTokenizer":
+        path = path or os.environ.get("FLEETX_VOCAB_DIR", ".")
+        return cls(
+            os.path.join(path, "vocab.json"), os.path.join(path, "merges.txt")
+        )
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.encoder)
+
+    def _bpe(self, token: str) -> str:
+        if token in self.cache:
+            return self.cache[token]
+        word: Tuple[str, ...] = tuple(token)
+        if len(word) == 1:
+            return token
+        while True:
+            pairs = {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+            best = min(pairs, key=lambda p: self.bpe_ranks.get(p, float("inf")))
+            if best not in self.bpe_ranks:
+                break
+            a, b = best
+            merged: List[str] = []
+            i = 0
+            while i < len(word):
+                if i < len(word) - 1 and word[i] == a and word[i + 1] == b:
+                    merged.append(a + b)
+                    i += 2
+                else:
+                    merged.append(word[i])
+                    i += 1
+            word = tuple(merged)
+            if len(word) == 1:
+                break
+        out = " ".join(word)
+        self.cache[token] = out
+        return out
+
+    def encode(self, text: str) -> List[int]:
+        ids: List[int] = []
+        for token in _PAT.findall(text):
+            token = "".join(self.byte_encoder[b] for b in token.encode("utf-8"))
+            ids.extend(self.encoder[t] for t in self._bpe(token).split(" "))
+        return ids
+
+    def decode(self, ids) -> str:
+        text = "".join(self.decoder[int(i)] for i in ids)
+        return bytearray(self.byte_decoder[c] for c in text).decode(
+            "utf-8", errors=self.errors
+        )
+
+    def __call__(self, text: str) -> Dict[str, List[int]]:
+        return {"input_ids": self.encode(text)}

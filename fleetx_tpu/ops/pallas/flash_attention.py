@@ -1,0 +1,276 @@
+"""Causal flash attention — Pallas TPU kernels with custom VJP.
+
+The TPU replacement for the reference's fused CUDA softmax-mask kernel +
+score-matrix attention (/root/reference/ppfleetx/models/language_model/gpt/
+dygraph/single_model.py:216-240 ``core_attn`` +
+``incubate.softmax_mask_fuse_upper_triangle``): online-softmax tiling keeps
+the [s, s] score matrix out of HBM entirely, so long sequences don't need the
+reference's ``recompute_granularity=core_attn`` memory workaround.
+
+Layout: q, k, v are [batch, seq, heads, head_dim] (model layout); kernels run
+per (batch*head) over q-row blocks, scanning k-column blocks up to the causal
+diagonal. fp32 accumulation, inputs any float dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    """Pallas interpreter mode off-TPU (CPU tests of kernel math)."""
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, scale: float):
+    """One (batch*head, q-block) program: online softmax over k blocks."""
+    bq, d = q_ref.shape
+    i = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * scale
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+
+    q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, block_k]
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    # causal: only k blocks at or before this q block contribute
+    # (block_q % block_k == 0 enforced at dispatch)
+    num_k_blocks = (i + 1) * bq // block_k
+    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+
+    o_ref[:] = (acc / l).astype(o_ref.dtype)
+    lse_ref[:] = (m + jnp.log(l)).reshape(lse_ref.shape)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, block_k: int, scale: float):
+    bq, d = q_ref.shape
+    i = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * scale
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:].reshape(bq, 1)
+    delta = delta_ref[:].reshape(bq, 1)
+    q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(j, dq):
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    num_k_blocks = (i + 1) * bq // block_k
+    dq = jax.lax.fori_loop(0, num_k_blocks, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q: int, scale: float, seq_len: int):
+    bk, d = k_ref.shape
+    j = pl.program_id(1)
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+
+    def body(ii, carry):
+        dk, dv = carry
+        # only q blocks at/after this k block see it (causal); iterate from
+        # the diagonal block to the end
+        i = j * bk // block_q + ii
+        q_blk = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
+        do_blk = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(i * block_q, block_q)].reshape(block_q, 1)
+        delta = delta_ref[pl.ds(i * block_q, block_q)].reshape(block_q, 1)
+        s = jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    first_q_block = j * bk // block_q
+    n_iter = seq_len // block_q - first_q_block
+    dk, dv = jax.lax.fori_loop(
+        0, n_iter, body, (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32))
+    )
+    # q blocks were loaded pre-scaled, so the chain rule's `scale` factor is
+    # already inside `ds @ q_scaled`
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _to_bh(x):
+    """[b, s, h, d] -> [b*h, s, d]"""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _from_bh(x, b, h):
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _fwd_call(q3, k3, v3, block_q, block_k, scale):
+    bh, s, d = q3.shape
+    grid = (bh, s // block_q)
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, block_q, block_k)
+    return out
+
+
+def _flash_fwd(q, k, v, block_q, block_k):
+    b, s, h, d = q.shape
+    scale = 1.0 / (d**0.5)
+    q3, k3, v3 = _to_bh(q), _to_bh(k), _to_bh(v)
+    o3, lse = _fwd_call(q3, k3, v3, block_q, block_k, scale)
+    return _from_bh(o3, b, h), (q3, k3, v3, o3, lse, b, h)
+
+
+def _flash_bwd(block_q, block_k, res, g):
+    q3, k3, v3, o3, lse, b, h = res
+    bh, s, d = q3.shape
+    scale = 1.0 / (d**0.5)
+    do3 = _to_bh(g)
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
+
+    dq3 = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=block_k, scale=scale),
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b_, i: (b_, i, 0)),
+            pl.BlockSpec((None, s, d), lambda b_, i: (b_, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda b_, i: (b_, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b_, i: (b_, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b_, i: (b_, i)),
+            pl.BlockSpec((None, block_q), lambda b_, i: (b_, i)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b_, i: (b_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)
+
+    dk3, dv3 = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, block_q=block_q, scale=scale, seq_len=s
+        ),
+        grid=(bh, s // block_k),
+        in_specs=[
+            pl.BlockSpec((None, s, d), lambda b_, j: (b_, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b_, j: (b_, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b_, j: (b_, j, 0)),
+            pl.BlockSpec((None, s, d), lambda b_, j: (b_, 0, 0)),
+            pl.BlockSpec((None, s), lambda b_, j: (b_, 0)),
+            pl.BlockSpec((None, s), lambda b_, j: (b_, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda b_, j: (b_, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b_, j: (b_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v3.dtype),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)
+
+    return _from_bh(dq3, *_bh_dims(res)), _from_bh(dk3, *_bh_dims(res)), _from_bh(dv3, *_bh_dims(res))
+
+
+def _bh_dims(res):
+    return res[5], res[6]
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> jax.Array:
+    """Causal flash attention, [b, s, h, d] layout. Sequence length must be a
+    multiple of the block sizes (callers fall back to the XLA path
+    otherwise — fleetx_tpu/ops/attention.py)."""
+    s = q.shape[1]
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k or block_q % block_k:
+        raise ValueError(f"seq {s} not tileable by ({block_q}, {block_k})")
+    return _flash(q, k, v, block_q, block_k)

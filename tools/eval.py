@@ -1,0 +1,32 @@
+"""Offline evaluation entry point (reference /root/reference/tools/eval.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from fleetx_tpu.core.engine import Trainer
+from fleetx_tpu.data import build_dataloader
+from fleetx_tpu.models import build_module
+from fleetx_tpu.parallel.env import init_dist_env
+from fleetx_tpu.utils.config import get_config, parse_args
+from fleetx_tpu.utils.log import logger
+
+
+def main():
+    args = parse_args()
+    init_dist_env()
+    cfg = get_config(args.config, overrides=args.override, show=False)
+    module = build_module(cfg)
+    loader = build_dataloader(cfg, "Eval")
+    trainer = Trainer(cfg, module, mode="eval")
+    first = next(iter(loader))
+    trainer.init_state(first)
+    if (cfg.Engine.save_load or {}).get("ckpt_dir"):
+        trainer.load()
+    loss = trainer.evaluate(loader)
+    logger.info("eval loss: %s", loss)
+
+
+if __name__ == "__main__":
+    main()

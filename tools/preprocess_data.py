@@ -1,0 +1,74 @@
+"""Offline corpus preprocessing: jsonl -> {prefix}_ids.npy + {prefix}_idx.npz
+(reference /root/reference/ppfleetx/data/data_tools/gpt/preprocess_data.py,
+same output format so corpora interchange with the reference).
+
+    python tools/preprocess_data.py --input data.jsonl --output-prefix my_corpus \
+        --vocab-dir /path/with/vocab.json+merges.txt [--json-key text] [--workers N]
+"""
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from fleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
+
+_tok = None
+
+
+def _init(vocab_dir):
+    global _tok
+    _tok = GPTTokenizer.from_pretrained(vocab_dir)
+
+
+def _encode(line):
+    try:
+        text = json.loads(line)[_encode.key]
+    except (json.JSONDecodeError, KeyError):
+        return None
+    ids = _tok.encode(text)
+    if not ids:
+        return None
+    ids.append(_tok.eos_token_id)
+    return np.asarray(ids, np.int32)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--input", required=True)
+    p.add_argument("--output-prefix", required=True)
+    p.add_argument("--json-key", default="text")
+    p.add_argument("--vocab-dir", default=None)
+    p.add_argument("--workers", type=int, default=1)
+    args = p.parse_args()
+
+    _encode.key = args.json_key
+    docs, lens = [], []
+    with open(args.input, encoding="utf-8") as f:
+        if args.workers > 1:
+            with mp.Pool(args.workers, initializer=_init, initargs=(args.vocab_dir,)) as pool:
+                for ids in pool.imap(_encode, f, chunksize=64):
+                    if ids is not None:
+                        docs.append(ids)
+                        lens.append(len(ids))
+        else:
+            _init(args.vocab_dir)
+            for line in f:
+                ids = _encode(line)
+                if ids is not None:
+                    docs.append(ids)
+                    lens.append(len(ids))
+
+    all_ids = np.concatenate(docs) if docs else np.zeros(0, np.int32)
+    np.save(args.output_prefix + "_ids.npy", all_ids)
+    np.savez(args.output_prefix + "_idx.npz", lens=np.asarray(lens, np.int32))
+    print(f"wrote {len(docs)} docs, {len(all_ids)} tokens -> {args.output_prefix}_(ids.npy|idx.npz)")
+
+
+if __name__ == "__main__":
+    main()

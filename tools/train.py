@@ -1,0 +1,43 @@
+"""Pretraining entry point (reference /root/reference/tools/train.py:44-72).
+
+    python tools/train.py -c configs/nlp/gpt/pretrain_gpt_345M_single_card.yaml \
+        -o Engine.max_steps=1000
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from fleetx_tpu.core.engine import Trainer
+from fleetx_tpu.data import build_dataloader
+from fleetx_tpu.models import build_module
+from fleetx_tpu.parallel.env import init_dist_env
+from fleetx_tpu.utils.config import get_config, parse_args
+from fleetx_tpu.utils.log import advertise, logger
+
+
+def main():
+    args = parse_args()
+    init_dist_env()
+    cfg = get_config(args.config, overrides=args.override, show=True)
+    advertise()
+
+    module = build_module(cfg)
+    train_loader = build_dataloader(cfg, "Train")
+    eval_loader = None
+    if cfg.Data and cfg.Data.get("Eval") and cfg.Engine.eval_freq:
+        eval_loader = build_dataloader(cfg, "Eval")
+
+    trainer = Trainer(cfg, module)
+    if (cfg.Engine.save_load or {}).get("ckpt_dir"):
+        first = next(iter(train_loader))
+        trainer.init_state(first)
+        trainer.load()
+        train_loader.batch_sampler.consumed_samples = trainer.consumed_samples
+    trainer.fit(train_loader, eval_loader)
+    logger.info("training done at step %d", int(trainer.state.step))
+
+
+if __name__ == "__main__":
+    main()
