@@ -115,6 +115,11 @@ class Trainer:
         self.max_steps = eng.max_steps
         self.num_train_epochs = eng.num_train_epochs
         self.accumulate_steps = eng.accumulate_steps or 1
+        dist_pp = ((cfg.Distributed or {}).get("pp_degree")) or 1
+        if dist_pp > 1:
+            # the pipelined model consumes the full local batch and streams
+            # microbatches itself; no outer accumulation scan
+            self.accumulate_steps = 1
         self.logging_freq = eng.logging_freq
         self.eval_freq = eng.eval_freq
         self.eval_iters = eng.eval_iters
@@ -280,7 +285,10 @@ class Trainer:
 
     # -------------------------------------------------------------- data prep
     def _microbatch(self, batch):
-        """First microbatch slice, host-side, for shape inference."""
+        """First microbatch slice, host-side, for shape inference. Pipelined
+        models consume the full batch (they micro-split internally)."""
+        if self.mesh_cfg.pp > 1:
+            return {k: np.asarray(v) for k, v in batch.items()}
         micro_total = self._micro_total()
         return {k: np.asarray(v)[:micro_total] for k, v in batch.items()}
 

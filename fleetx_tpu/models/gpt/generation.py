@@ -1,0 +1,166 @@
+"""Autoregressive generation with kv-cache — greedy / temperature sampling /
+top-k / top-p, plus logits processors.
+
+Parity with the reference decode stack (/root/reference/ppfleetx/models/
+language_model/gpt/dygraph/single_model.py:781-1247 ``GPTForGeneration`` and
+processor.py logits processors), redesigned for XLA: the decode loop is a
+``lax.while_loop`` over a static-shape token buffer (no dynamic shapes), the
+cache is the flax 'cache' collection, and one compiled step serves the whole
+generation — the reference re-runs a Python loop per token.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GenerationConfig", "generate", "process_logits"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    max_length: int = 64  # new tokens to generate
+    min_length: int = 0
+    decode_strategy: str = "sampling"  # 'greedy' | 'sampling'
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    repetition_penalty: float = 1.0
+    eos_token_id: int = 50256
+    pad_token_id: int = 50256
+    forced_eos_token_id: Optional[int] = None
+
+    @classmethod
+    def from_config(cls, gen_cfg) -> "GenerationConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in dict(gen_cfg or {}).items() if k in known and v is not None}
+        if "max_dec_len" in dict(gen_cfg or {}):
+            kw["max_length"] = gen_cfg["max_dec_len"]
+        return cls(**kw)
+
+
+def process_logits(logits, tokens, cur_len, cfg: GenerationConfig):
+    """Min-length EOS suppression, repetition penalty, forced EOS (reference
+    processor.py: MinLengthLogitsProcessor, RepetitionPenaltyLogitsProcessor,
+    ForcedEOSTokenLogitsProcessor)."""
+    vocab = logits.shape[-1]
+    if cfg.min_length > 0:
+        logits = jnp.where(
+            (cur_len < cfg.min_length)
+            & (jnp.arange(vocab)[None, :] == cfg.eos_token_id),
+            -1e9,
+            logits,
+        )
+    if cfg.repetition_penalty != 1.0:
+        # penalize every token already present in the sequence
+        onehot_seen = jax.nn.one_hot(tokens, vocab, dtype=jnp.bool_.dtype).any(axis=1)
+        penalized = jnp.where(
+            logits > 0, logits / cfg.repetition_penalty, logits * cfg.repetition_penalty
+        )
+        logits = jnp.where(onehot_seen, penalized, logits)
+    if cfg.forced_eos_token_id is not None:
+        at_last = cur_len >= (tokens.shape[1] - 1)
+        forced = jnp.full_like(logits, -1e9).at[:, cfg.forced_eos_token_id].set(0.0)
+        logits = jnp.where(at_last, forced, logits)
+    return logits
+
+
+def _sample(logits, rng, cfg: GenerationConfig):
+    if cfg.decode_strategy == "greedy":
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.maximum(cfg.temperature, 1e-6)
+    if cfg.top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -cfg.top_k][:, None]
+        logits = jnp.where(logits < kth, -1e9, logits)
+    if cfg.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p; always keep the best
+        cutoff_idx = jnp.sum(cum < cfg.top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -1e9, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def generate(
+    model,
+    variables: Dict[str, Any],
+    input_ids: jax.Array,
+    gen_cfg: GenerationConfig,
+    rng: Optional[jax.Array] = None,
+    attention_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Returns [batch, prompt_len + max_length] tokens (padded after EOS).
+
+    Prefill runs the full prompt once to populate the cache; the while_loop
+    then decodes one token per iteration with static shapes throughout.
+    """
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    b, prompt_len = input_ids.shape
+    total_len = prompt_len + gen_cfg.max_length
+
+    params = variables["params"] if "params" in variables else variables
+
+    # static token buffer
+    tokens = jnp.full((b, total_len), gen_cfg.pad_token_id, jnp.int32)
+    tokens = jax.lax.dynamic_update_slice(tokens, input_ids.astype(jnp.int32), (0, 0))
+
+    # init cache at full length via a dummy decode-mode init
+    init_vars = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((b, 1), jnp.int32),
+        jnp.zeros((b, 1), jnp.int32),
+        decode=True,
+    )
+    cache = init_vars["cache"]
+
+    # prefill: feed the whole prompt, cache fills positions [0, prompt_len)
+    pos = jnp.arange(prompt_len, dtype=jnp.int32)[None, :]
+    logits, mut = model.apply(
+        {"params": params, "cache": cache},
+        input_ids.astype(jnp.int32),
+        pos,
+        decode=True,
+        mutable=["cache"],
+    )
+    cache = mut["cache"]
+    rng, step_rng = jax.random.split(rng)
+    next_logits = process_logits(
+        logits[:, -1, :], tokens, jnp.asarray(prompt_len), gen_cfg
+    )
+    next_tok = _sample(next_logits, step_rng, gen_cfg).astype(jnp.int32)
+    tokens = jax.lax.dynamic_update_slice(tokens, next_tok[:, None], (0, prompt_len))
+    finished = next_tok == gen_cfg.eos_token_id
+
+    def cond(state):
+        i, _, _, finished, _ = state
+        return (i < total_len) & ~jnp.all(finished)
+
+    def body(state):
+        i, tokens, cache, finished, rng = state
+        cur = jax.lax.dynamic_slice(tokens, (0, i - 1), (b, 1))
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            cur,
+            (i - 1) * jnp.ones((b, 1), jnp.int32),
+            decode=True,
+            mutable=["cache"],
+        )
+        cache = mut["cache"]
+        rng, step_rng = jax.random.split(rng)
+        nl = process_logits(logits[:, -1, :], tokens, i, gen_cfg)
+        tok = _sample(nl, step_rng, gen_cfg).astype(jnp.int32)
+        tok = jnp.where(finished, gen_cfg.pad_token_id, tok)
+        tokens = jax.lax.dynamic_update_slice(tokens, tok[:, None], (0, i))
+        finished = finished | (tok == gen_cfg.eos_token_id)
+        return i + 1, tokens, cache, finished, rng
+
+    _, tokens, _, _, _ = jax.lax.while_loop(
+        cond, body, (jnp.asarray(prompt_len + 1), tokens, cache, finished, rng)
+    )
+    return tokens

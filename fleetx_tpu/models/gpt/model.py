@@ -57,6 +57,9 @@ class GPTConfig:
     use_flash_attention: bool = True
     scan_layers: bool = True
     dtype: Dtype = jnp.bfloat16  # compute dtype; params always fp32
+    # pipeline parallelism (consumed by fleetx_tpu/parallel/pipeline.py)
+    pp_degree: int = 1
+    num_microbatches: int = 1
     # MoE (consumed by fleetx_tpu/parallel/moe.py when num_experts > 1)
     num_experts: int = 1
     expert_mode: bool = False
@@ -126,8 +129,10 @@ class SelfAttention(nn.Module):
             k = _dense((nh, hd), ("embed", "heads", "kv"), "k_proj", dtype=cfg.dtype)(x)
             v = _dense((nh, hd), ("embed", "heads", "kv"), "v_proj", dtype=cfg.dtype)(x)
 
+        causal = True
         if decode:
             k, v, attn_mask = self._update_cache(k, v, attn_mask)
+            causal = False  # the cache mask encodes absolute-position causality
 
         dropout_rng = None
         if cfg.attention_probs_dropout_prob > 0.0 and not deterministic:
@@ -136,7 +141,7 @@ class SelfAttention(nn.Module):
             q,
             k,
             v,
-            causal=True,
+            causal=causal,
             attn_mask=attn_mask,
             dropout_rate=cfg.attention_probs_dropout_prob,
             dropout_rng=dropout_rng,
@@ -159,9 +164,10 @@ class SelfAttention(nn.Module):
         return checkpoint_name(out, "attn_out")
 
     def _update_cache(self, k, v, attn_mask):
-        """Incremental decode: append this step's k/v at cache_index.
-        Cache layout [batch, max_len, heads, head_dim]; cache_heads logical
-        axis keeps the cache TP-sharded with the projections."""
+        """Incremental decode: append this step's k/v at cache_index and
+        build the absolute-position causal mask (query i at absolute position
+        start+i may see cache positions <= start+i). Cache layout
+        [batch, max_len, heads, head_dim]."""
         is_init = not self.has_variable("cache", "cached_key")
         b, s, nh, hd = k.shape
         max_len = self.cfg.max_position_embeddings
@@ -178,9 +184,14 @@ class SelfAttention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, start, 0, 0))
             idx.value = start + s
             k, v = ck.value, cv.value
-            # positions beyond the filled prefix must be hidden
-            valid = jnp.arange(max_len)[None, None, None, :] < idx.value
-            attn_mask = valid if attn_mask is None else (attn_mask.astype(bool) & valid)
+            q_pos = start + jnp.arange(s)  # absolute positions of the queries
+            k_pos = jnp.arange(max_len)
+            causal = (k_pos[None, :] <= q_pos[:, None])[None, None, :, :]
+            attn_mask = (
+                causal
+                if attn_mask is None
+                else (attn_mask.astype(bool) & causal)
+            )
         return k, v, attn_mask
 
 
@@ -322,6 +333,21 @@ class GPTModel(nn.Module):
         cfg = self.cfg
         policy = _remat_policy(cfg)
         selective = cfg.no_recompute_layers
+        if cfg.pp_degree > 1 and not decode:
+            from fleetx_tpu.parallel.pipeline import PipelinedStack
+
+            layer_cls = _ScanLayer
+            if policy is not None:
+                layer_cls = nn.remat(
+                    _ScanLayer, policy=policy, prevent_cse=False, static_argnums=(3, 4)
+                )
+            return PipelinedStack(
+                cfg,
+                layer_cls,
+                cfg.pp_degree,
+                max(cfg.num_microbatches, 1),
+                name="layers",
+            )(x, attn_mask, deterministic)
         if cfg.scan_layers and not selective:
             layer_cls = _ScanLayer
             if policy is not None:
@@ -333,7 +359,7 @@ class GPTModel(nn.Module):
                 )
             stack = nn.scan(
                 layer_cls,
-                variable_axes={"params": 0, "cache": 0},
+                variable_axes={"params": 0, "cache": 0, "intermediates": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
                 length=cfg.num_layers,

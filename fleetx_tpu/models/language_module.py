@@ -71,7 +71,16 @@ class GPTModule(LanguageModule):
         name = mp.get("dtype") or ("bfloat16" if mp.get("use_pure_fp16") else "float32")
         dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.bfloat16,
                  "float32": jnp.float32}[str(name)]
-        gcfg = GPTConfig(**{**gcfg.__dict__, "dtype": dtype})
+        extra = {"dtype": dtype}
+        dist = getattr(self.cfg, "Distributed", None) or {}
+        pp = dist.get("pp_degree") or 1
+        if pp > 1:
+            # PP folds grad accumulation into the pipeline's microbatch
+            # stream (reference pipeline_configs accumulate_steps semantics,
+            # env.py:103-107)
+            extra["pp_degree"] = pp
+            extra["num_microbatches"] = max(eng.get("accumulate_steps") or 1, 1)
+        gcfg = GPTConfig(**{**gcfg.__dict__, **extra})
         self.gpt_config = gcfg
         return GPTForPretraining(gcfg)
 

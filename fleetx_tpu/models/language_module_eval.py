@@ -1,0 +1,64 @@
+"""GPTEvalModule — offline WikiText perplexity / LAMBADA cloze accuracy
+(reference /root/reference/ppfleetx/models/language_model/
+language_module.py:586-703: swaps the eval dataset class and scores
+sum-of-log-probs (PPL) or exact-match on the target word (ACC))."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fleetx_tpu.models.language_module import GPTModule
+
+__all__ = ["GPTEvalModule"]
+
+
+class GPTEvalModule(GPTModule):
+    """Batch contract: same (tokens, position_ids, labels, loss_mask) dict;
+    scoring accumulates un-normalized nll + mask counts host-side."""
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        eval_cfg = cfg.get("Offline_Eval") or {}
+        self.eval_type = "lambada" if eval_cfg.get("cloze_eval") else "wikitext"
+        self._score_fn = None
+
+    def score_batch(self, params, batch) -> Dict[str, np.ndarray]:
+        if self._score_fn is None:
+            def score(params, batch):
+                logits = self.nets.apply(
+                    {"params": params}, batch["tokens"], batch.get("position_ids")
+                ).astype(jnp.float32)
+                logz = jax.nn.logsumexp(logits, axis=-1)
+                tgt = jnp.take_along_axis(
+                    logits, batch["labels"][..., None], axis=-1
+                )[..., 0]
+                nll = (logz - tgt) * batch["loss_mask"]
+                # cloze correctness: every masked target predicted exactly
+                pred = jnp.argmax(logits, axis=-1)
+                wrong = ((pred != batch["labels"]) & (batch["loss_mask"] > 0)).any(axis=1)
+                return {
+                    "nll_sum": nll.sum(),
+                    "token_count": batch["loss_mask"].sum(),
+                    "correct": (~wrong).sum(),
+                    "examples": jnp.asarray(batch["tokens"].shape[0], jnp.float32),
+                }
+
+            self._score_fn = jax.jit(score)
+        return {k: np.asarray(v) for k, v in self._score_fn(params, batch).items()}
+
+    def evaluate_dataset(self, params, loader) -> Dict[str, float]:
+        total = {"nll_sum": 0.0, "token_count": 0.0, "correct": 0.0, "examples": 0.0}
+        for batch in loader:
+            out = self.score_batch(params, batch)
+            for k in total:
+                total[k] += float(out[k])
+        if self.eval_type == "lambada":
+            acc = total["correct"] / max(total["examples"], 1.0)
+            return {"acc": acc, "examples": int(total["examples"])}
+        ppl = math.exp(total["nll_sum"] / max(total["token_count"], 1.0))
+        return {"ppl": ppl, "tokens": int(total["token_count"])}

@@ -1,0 +1,70 @@
+"""GPTGenerationModule — text-in/text-out generation driver (reference
+/root/reference/ppfleetx/models/language_model/language_module.py:484-585)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import jax
+import numpy as np
+
+from fleetx_tpu.models.gpt.generation import GenerationConfig, generate
+from fleetx_tpu.models.language_module import GPTModule
+
+__all__ = ["GPTGenerationModule"]
+
+
+class GPTGenerationModule(GPTModule):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.generation_cfg = GenerationConfig.from_config(cfg.get("Generation"))
+        self._tokenizer = None
+        self._variables = None
+        self._compiled_generate = None
+
+    @property
+    def tokenizer(self):
+        if self._tokenizer is None:
+            from fleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
+
+            vocab_dir = (self.cfg.get("Generation") or {}).get("vocab_dir")
+            self._tokenizer = GPTTokenizer.from_pretrained(vocab_dir)
+        return self._tokenizer
+
+    def set_state(self, variables):
+        """Install trained variables ({'params': ...})."""
+        self._variables = variables
+
+    def generate_ids(
+        self, input_ids: np.ndarray, rng: Optional[jax.Array] = None
+    ) -> np.ndarray:
+        if self._variables is None:
+            raise RuntimeError("call set_state(variables) first")
+        if self._compiled_generate is None:
+            gen_cfg = self.generation_cfg
+
+            def run(variables, ids, rng):
+                return generate(self.nets, variables, ids, gen_cfg, rng)
+
+            self._compiled_generate = jax.jit(run)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        return np.asarray(self._compiled_generate(self._variables, input_ids, rng))
+
+    def generate(self, text: Union[str, List[str]], rng=None) -> List[str]:
+        """Tokenize -> decode loop -> detokenize (left-pads a batch of
+        prompts to equal length)."""
+        prompts = [text] if isinstance(text, str) else list(text)
+        tok = self.tokenizer
+        encoded = [tok.encode(p) for p in prompts]
+        max_len = max(len(e) for e in encoded)
+        pad = tok.pad_token_id
+        ids = np.full((len(encoded), max_len), pad, np.int32)
+        for i, e in enumerate(encoded):
+            ids[i, max_len - len(e):] = e  # left-pad so decode starts aligned
+        out = self.generate_ids(ids, rng)
+        results = []
+        for i, e in enumerate(encoded):
+            gen = out[i, max_len:]
+            gen = gen[gen != pad]
+            results.append(tok.decode(gen.tolist()))
+        return results

@@ -1,0 +1,36 @@
+"""MoEModule — GPT pretraining with MoE FFN + balance loss (reference
+/root/reference/ppfleetx/models/language_model/language_module.py:704-819:
+adds gate balance loss to the LM loss; the reference's manual mp/dp param
+broadcast + expert no_sync bookkeeping :786-819 is unnecessary here — expert
+params are mesh-sharded like any other)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fleetx_tpu.models.gpt.model import pretraining_loss
+from fleetx_tpu.models.language_module import GPTModule
+
+__all__ = ["MoEModule"]
+
+
+class MoEModule(GPTModule):
+    def loss_fn(self, params, batch, rng, train: bool):
+        logits, mutated = self.nets.apply(
+            {"params": params},
+            batch["tokens"],
+            batch.get("position_ids"),
+            deterministic=not train,
+            rngs={"dropout": rng} if train and rng is not None else None,
+            mutable=["intermediates"],
+        )
+        lm_loss = pretraining_loss(logits, batch["labels"], batch["loss_mask"])
+        balance = jnp.asarray(0.0, jnp.float32)
+        count = 0
+        for leaf in jax.tree.leaves(mutated.get("intermediates", {})):
+            balance = balance + jnp.sum(leaf)
+            count += 1
+        weight = self.gpt_config.balance_loss_weight
+        total = lm_loss + weight * balance
+        return total, {"lm_loss": lm_loss, "balance_loss": balance}

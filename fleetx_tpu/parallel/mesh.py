@@ -77,6 +77,8 @@ def build_mesh(
     if devices is None:
         devices = jax.devices()
     shape = (cfg.pp, cfg.dp, cfg.fsdp, cfg.mp)
+    if cfg.nranks < len(devices):
+        devices = list(devices)[: cfg.nranks]  # sub-mesh of the first N
     if cfg.nranks != len(devices):
         raise ValueError(
             f"mesh {shape} needs {cfg.nranks} devices, have {len(devices)}"

@@ -16,9 +16,14 @@ def test_mesh_shapes(eight_devices):
     assert mesh.shape == {"pp": 1, "dp": 2, "fsdp": 2, "mp": 2}
 
 
-def test_mesh_wrong_count_raises(eight_devices):
+def test_mesh_too_many_devices_needed_raises(eight_devices):
     with pytest.raises(ValueError):
-        build_mesh(MeshConfig(dp=3, mp=2))
+        build_mesh(MeshConfig(dp=3, mp=4))  # 12 > 8 available
+
+
+def test_mesh_submesh_of_available(eight_devices):
+    mesh = build_mesh(MeshConfig(dp=3, mp=2))  # 6 of 8 devices
+    assert mesh.shape["dp"] == 3 and mesh.shape["mp"] == 2
 
 
 def test_from_dist_config(eight_devices):
