@@ -35,28 +35,45 @@ class GenerationConfig:
 
     @classmethod
     def from_config(cls, gen_cfg) -> "GenerationConfig":
+        d = dict(gen_cfg or {})
         known = {f.name for f in dataclasses.fields(cls)}
-        kw = {k: v for k, v in dict(gen_cfg or {}).items() if k in known and v is not None}
-        if "max_dec_len" in dict(gen_cfg or {}):
-            kw["max_length"] = gen_cfg["max_dec_len"]
+        kw = {k: v for k, v in d.items() if k in known and v is not None}
+        if d.get("max_dec_len") is not None:
+            kw["max_length"] = d["max_dec_len"]
+        if d.get("min_dec_len") is not None:
+            kw["min_length"] = d["min_dec_len"]
         return cls(**kw)
 
 
-def process_logits(logits, tokens, cur_len, cfg: GenerationConfig):
+def process_logits(logits, tokens, cur_len, cfg: GenerationConfig, *,
+                   prompt_len=0, token_valid=None):
     """Min-length EOS suppression, repetition penalty, forced EOS (reference
     processor.py: MinLengthLogitsProcessor, RepetitionPenaltyLogitsProcessor,
-    ForcedEOSTokenLogitsProcessor)."""
+    ForcedEOSTokenLogitsProcessor).
+
+    ``cur_len`` is the absolute buffer position; min_length counts DECODED
+    tokens, so the EOS ban runs while cur_len < prompt_len + min_length
+    (the reference offsets min_length by the input length,
+    single_model.py:1222). ``token_valid`` [b, total_len] marks buffer slots
+    holding real tokens (False for left-pad slots and not-yet-generated
+    tail), keeping the repetition penalty off pad/eos ghosts."""
     vocab = logits.shape[-1]
     if cfg.min_length > 0:
         logits = jnp.where(
-            (cur_len < cfg.min_length)
+            (cur_len < prompt_len + cfg.min_length)
             & (jnp.arange(vocab)[None, :] == cfg.eos_token_id),
             -1e9,
             logits,
         )
     if cfg.repetition_penalty != 1.0:
-        # penalize every token already present in the sequence
-        onehot_seen = jax.nn.one_hot(tokens, vocab, dtype=jnp.bool_.dtype).any(axis=1)
+        # penalize every token already actually emitted/fed (not buffer pads)
+        seen_pos = jnp.arange(tokens.shape[1])[None, :] < cur_len
+        if token_valid is not None:
+            seen_pos = seen_pos & token_valid
+        onehot_seen = (
+            jax.nn.one_hot(tokens, vocab, dtype=jnp.bool_.dtype)
+            & seen_pos[..., None]
+        ).any(axis=1)
         penalized = jnp.where(
             logits > 0, logits / cfg.repetition_penalty, logits * cfg.repetition_penalty
         )
@@ -98,40 +115,73 @@ def generate(
 
     Prefill runs the full prompt once to populate the cache; the while_loop
     then decodes one token per iteration with static shapes throughout.
+    ``attention_mask`` [b, prompt_len] marks real prompt tokens (0 = left
+    pad): pad slots are never attended to, and position ids are shifted so
+    each row's first real token sits at position 0.
     """
     if rng is None:
         rng = jax.random.PRNGKey(0)
     b, prompt_len = input_ids.shape
     total_len = prompt_len + gen_cfg.max_length
+    max_pos = model.cfg.max_position_embeddings
+    if total_len > max_pos:
+        raise ValueError(
+            f"prompt_len({prompt_len}) + max_length({gen_cfg.max_length}) "
+            f"exceeds max_position_embeddings({max_pos})"
+        )
 
     params = variables["params"] if "params" in variables else variables
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, prompt_len), jnp.int32)
+    attention_mask = attention_mask.astype(jnp.int32)
+    # per-row left-pad count; generated token at buffer slot i has position
+    # i - pad_count (first REAL token of each row sits at position 0)
+    pad_counts = prompt_len - attention_mask.sum(axis=1)
+    # which kv-cache slots hold real tokens: prompt slots per the mask,
+    # everything generated afterwards is real
+    kv_valid = jnp.concatenate(
+        [attention_mask.astype(bool),
+         jnp.ones((b, max_pos - prompt_len), bool)], axis=1,
+    )
+    kv_mask = kv_valid[:, None, None, :]  # [b, 1, 1(q), max_pos(kv)]
+    # buffer-slot validity for the repetition penalty
+    token_valid = jnp.concatenate(
+        [attention_mask.astype(bool),
+         jnp.ones((b, total_len - prompt_len), bool)], axis=1,
+    )
 
     # static token buffer
     tokens = jnp.full((b, total_len), gen_cfg.pad_token_id, jnp.int32)
     tokens = jax.lax.dynamic_update_slice(tokens, input_ids.astype(jnp.int32), (0, 0))
 
-    # init cache at full length via a dummy decode-mode init
-    init_vars = model.init(
-        jax.random.PRNGKey(0),
-        jnp.zeros((b, 1), jnp.int32),
-        jnp.zeros((b, 1), jnp.int32),
-        decode=True,
-    )
-    cache = init_vars["cache"]
+    # init cache at full length: the fresh cache is deterministically zeros
+    # (+ zero index), so build it from shapes only — no param sampling or
+    # forward trace per call
+    cache_shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((b, 1), jnp.int32),
+            jnp.zeros((b, 1), jnp.int32),
+            decode=True,
+        )
+    )["cache"]
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
 
     # prefill: feed the whole prompt, cache fills positions [0, prompt_len)
-    pos = jnp.arange(prompt_len, dtype=jnp.int32)[None, :]
+    pos = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0)
     logits, mut = model.apply(
         {"params": params, "cache": cache},
         input_ids.astype(jnp.int32),
         pos,
+        kv_mask,
         decode=True,
         mutable=["cache"],
     )
     cache = mut["cache"]
     rng, step_rng = jax.random.split(rng)
     next_logits = process_logits(
-        logits[:, -1, :], tokens, jnp.asarray(prompt_len), gen_cfg
+        logits[:, -1, :], tokens, jnp.asarray(prompt_len), gen_cfg,
+        prompt_len=prompt_len, token_valid=token_valid,
     )
     next_tok = _sample(next_logits, step_rng, gen_cfg).astype(jnp.int32)
     tokens = jax.lax.dynamic_update_slice(tokens, next_tok[:, None], (0, prompt_len))
@@ -147,13 +197,15 @@ def generate(
         logits, mut = model.apply(
             {"params": params, "cache": cache},
             cur,
-            (i - 1) * jnp.ones((b, 1), jnp.int32),
+            (i - 1 - pad_counts)[:, None].astype(jnp.int32),
+            kv_mask,
             decode=True,
             mutable=["cache"],
         )
         cache = mut["cache"]
         rng, step_rng = jax.random.split(rng)
-        nl = process_logits(logits[:, -1, :], tokens, i, gen_cfg)
+        nl = process_logits(logits[:, -1, :], tokens, i, gen_cfg,
+                            prompt_len=prompt_len, token_valid=token_valid)
         tok = _sample(nl, step_rng, gen_cfg).astype(jnp.int32)
         tok = jnp.where(finished, gen_cfg.pad_token_id, tok)
         tokens = jax.lax.dynamic_update_slice(tokens, tok[:, None], (0, i))

@@ -32,23 +32,35 @@ class GPTGenerationModule(GPTModule):
         return self._tokenizer
 
     def set_state(self, variables):
-        """Install trained variables ({'params': ...})."""
-        self._variables = variables
+        """Install trained variables ({'params': ...}). Pipeline-trained
+        param trees (gpt/layers/pipe/stages/...) are remapped to the
+        sequential scan layout the decode path uses."""
+        from fleetx_tpu.parallel.pipeline import maybe_pipeline_params_to_sequential
+
+        self._variables = maybe_pipeline_params_to_sequential(variables)
 
     def generate_ids(
-        self, input_ids: np.ndarray, rng: Optional[jax.Array] = None
+        self,
+        input_ids: np.ndarray,
+        rng: Optional[jax.Array] = None,
+        attention_mask: Optional[np.ndarray] = None,
     ) -> np.ndarray:
         if self._variables is None:
             raise RuntimeError("call set_state(variables) first")
         if self._compiled_generate is None:
             gen_cfg = self.generation_cfg
 
-            def run(variables, ids, rng):
-                return generate(self.nets, variables, ids, gen_cfg, rng)
+            def run(variables, ids, rng, mask):
+                return generate(self.nets, variables, ids, gen_cfg, rng,
+                                attention_mask=mask)
 
             self._compiled_generate = jax.jit(run)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        return np.asarray(self._compiled_generate(self._variables, input_ids, rng))
+        if attention_mask is None:
+            attention_mask = np.ones_like(input_ids, dtype=np.int32)
+        return np.asarray(
+            self._compiled_generate(self._variables, input_ids, rng, attention_mask)
+        )
 
     def generate(self, text: Union[str, List[str]], rng=None) -> List[str]:
         """Tokenize -> decode loop -> detokenize (left-pads a batch of
@@ -59,9 +71,11 @@ class GPTGenerationModule(GPTModule):
         max_len = max(len(e) for e in encoded)
         pad = tok.pad_token_id
         ids = np.full((len(encoded), max_len), pad, np.int32)
+        mask = np.zeros((len(encoded), max_len), np.int32)
         for i, e in enumerate(encoded):
             ids[i, max_len - len(e):] = e  # left-pad so decode starts aligned
-        out = self.generate_ids(ids, rng)
+            mask[i, max_len - len(e):] = 1
+        out = self.generate_ids(ids, rng, attention_mask=mask)
         results = []
         for i, e in enumerate(encoded):
             gen = out[i, max_len:]

@@ -26,11 +26,15 @@ class MoEModule(GPTModule):
             mutable=["intermediates"],
         )
         lm_loss = pretraining_loss(logits, batch["labels"], batch["loss_mask"])
+        # each MoE layer sows one aux loss (stacked along the scan axis);
+        # average over layers so balance_loss_weight is depth-invariant
         balance = jnp.asarray(0.0, jnp.float32)
-        count = 0
+        n_aux = 0
         for leaf in jax.tree.leaves(mutated.get("intermediates", {})):
             balance = balance + jnp.sum(leaf)
-            count += 1
+            n_aux += leaf.size
+        if n_aux:
+            balance = balance / n_aux
         weight = self.gpt_config.balance_loss_weight
         total = lm_loss + weight * balance
         return total, {"lm_loss": lm_loss, "balance_loss": balance}

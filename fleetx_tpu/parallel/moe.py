@@ -110,7 +110,10 @@ class MoEMLP(nn.Module):
         f = cfg.ffn_size
         E = cfg.num_experts
         n = b * s
-        capacity = max(1, int(cfg.capacity_factor * n * cfg.top_k / E))
+        # switch gate is top-1 regardless of cfg.top_k; capacity must use the
+        # effective k or switch capacity doubles vs the reference semantics
+        eff_top_k = 1 if cfg.gate == "switch" else cfg.top_k
+        capacity = max(1, int(cfg.capacity_factor * n * eff_top_k / E))
 
         tokens = x.reshape(n, h)
 
@@ -133,9 +136,8 @@ class MoEMLP(nn.Module):
             gate_logits = gate_logits * noise
 
         rng = self.make_rng("dropout") if (cfg.gate == "gshard" and self.has_rng("dropout")) else None
-        top_k = 1 if cfg.gate == "switch" else cfg.top_k
         dispatch, combine, aux = compute_routing(
-            gate_logits, top_k, capacity, cfg.gate, rng
+            gate_logits, eff_top_k, capacity, cfg.gate, rng
         )
         self.sow("intermediates", "balance_loss", aux)
 

@@ -33,7 +33,68 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-__all__ = ["PipelinedStack"]
+__all__ = [
+    "PipelinedStack",
+    "sequential_params_to_pipeline",
+    "pipeline_params_to_sequential",
+    "maybe_pipeline_params_to_sequential",
+]
+
+_SEQ_PREFIX = "gpt/layers/layer/"
+_PIPE_PREFIX = "gpt/layers/pipe/stages/layers/layer/"
+
+
+def _flatten(variables):
+    import flax
+
+    params = variables["params"] if "params" in variables else variables
+    flat = flax.traverse_util.flatten_dict(flax.core.unfreeze(params), sep="/")
+    return flat, ("params" in variables)
+
+
+def _unflatten(flat, wrap):
+    import flax
+
+    tree = flax.traverse_util.unflatten_dict(flat, sep="/")
+    return {"params": tree} if wrap else tree
+
+
+def sequential_params_to_pipeline(variables, pp: int):
+    """Remap a sequential-scan param tree (gpt/layers/layer/* with leading
+    [num_layers] axis) to the pipeline layout (gpt/layers/pipe/stages/
+    layers/layer/* with leading [pp, layers_per_stage] axes)."""
+    flat, wrap = _flatten(variables)
+    out = {}
+    for k, v in flat.items():
+        if k.startswith(_SEQ_PREFIX):
+            nk = _PIPE_PREFIX + k[len(_SEQ_PREFIX):]
+            out[nk] = v.reshape((pp, v.shape[0] // pp) + v.shape[1:])
+        else:
+            out[k] = v
+    return _unflatten(out, wrap)
+
+
+def pipeline_params_to_sequential(variables):
+    """Inverse of :func:`sequential_params_to_pipeline`: merge the
+    [pp, layers_per_stage] leading axes back into [num_layers] so a
+    pipeline-trained checkpoint can drive the scan decode/eval path."""
+    flat, wrap = _flatten(variables)
+    out = {}
+    for k, v in flat.items():
+        if k.startswith(_PIPE_PREFIX):
+            nk = _SEQ_PREFIX + k[len(_PIPE_PREFIX):]
+            out[nk] = v.reshape((v.shape[0] * v.shape[1],) + v.shape[2:])
+        else:
+            out[k] = v
+    return _unflatten(out, wrap)
+
+
+def maybe_pipeline_params_to_sequential(variables):
+    """Remap iff the tree holds pipeline-layout params; no-op otherwise."""
+    flat, _ = _flatten(variables)
+    if any(k.startswith(_PIPE_PREFIX) for k in flat):
+        return pipeline_params_to_sequential(variables)
+    return variables
 
 
 class _StageStack(nn.Module):
@@ -106,6 +167,13 @@ class PipelinedStack(nn.Module):
         pp = self.pp
         M = self.num_microbatches
         b, s, h = x.shape
+        if attn_mask is not None and attn_mask.ndim >= 1 and attn_mask.shape[0] not in (1,):
+            # a per-example mask would need to stream through the stage
+            # buffer alongside x; only batch-agnostic masks are supported
+            raise ValueError(
+                "PipelinedStack supports only batch-agnostic attn_mask "
+                f"(leading dim 1), got shape {attn_mask.shape}"
+            )
         if cfg.num_layers % pp:
             raise ValueError(f"num_layers {cfg.num_layers} % pp {pp} != 0")
         if b % M:

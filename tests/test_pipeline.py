@@ -26,18 +26,38 @@ BASE = dict(
 
 
 def _remap_scan_params_to_pipeline(v_seq, pp, layers_per_stage):
-    """gpt/layers/layer/* [L, ...] -> gpt/layers/pipe/stages/layers/layer/*
-    [pp, Lp, ...]."""
-    flat = flax.traverse_util.flatten_dict(flax.core.unfreeze(v_seq["params"]), sep="/")
-    out = {}
-    for k, v in flat.items():
-        val = v.value if hasattr(v, "value") else v
-        if k.startswith("gpt/layers/layer/"):
-            nk = k.replace("gpt/layers/layer/", "gpt/layers/pipe/stages/layers/layer/")
-            out[nk] = val.reshape((pp, layers_per_stage) + val.shape[1:])
-        else:
-            out[k] = val
-    return {"params": flax.traverse_util.unflatten_dict(out, sep="/")}
+    from fleetx_tpu.parallel.pipeline import sequential_params_to_pipeline
+
+    unboxed = jax.tree.map(
+        lambda v: v.value if hasattr(v, "value") else v,
+        flax.core.unfreeze(v_seq["params"]),
+        is_leaf=lambda v: hasattr(v, "value"),
+    )
+    return sequential_params_to_pipeline({"params": unboxed}, pp)
+
+
+def test_pipeline_param_remap_roundtrip():
+    from fleetx_tpu.parallel.pipeline import (
+        maybe_pipeline_params_to_sequential,
+        sequential_params_to_pipeline,
+    )
+
+    model = GPTForPretraining(GPTConfig(**BASE))
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), tokens)
+    v = {"params": jax.tree.map(
+        lambda x: x.value if hasattr(x, "value") else x, flax.core.unfreeze(v["params"]),
+        is_leaf=lambda x: hasattr(x, "value"),
+    )}
+    pipe = sequential_params_to_pipeline(v, 2)
+    back = maybe_pipeline_params_to_sequential(pipe)
+    flat_v = flax.traverse_util.flatten_dict(v["params"], sep="/")
+    flat_b = flax.traverse_util.flatten_dict(back["params"], sep="/")
+    assert set(flat_v) == set(flat_b)
+    for k in flat_v:
+        np.testing.assert_array_equal(np.asarray(flat_v[k]), np.asarray(flat_b[k]))
+    # no-op on already-sequential trees
+    assert maybe_pipeline_params_to_sequential(v) is v
 
 
 def test_pipeline_matches_sequential():
