@@ -160,7 +160,7 @@ class Trainer:
 
         import flax.linen as nn
 
-        with nn.logical_axis_rules(list(self.rules)):
+        with self.mesh, nn.logical_axis_rules(list(self.rules)):
             abstract = jax.eval_shape(_init, self.root_key)
         shardings = self._state_shardings(abstract)
         with self.mesh, nn.logical_axis_rules(list(self.rules)):

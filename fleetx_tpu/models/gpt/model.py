@@ -60,6 +60,9 @@ class GPTConfig:
     # pipeline parallelism (consumed by fleetx_tpu/parallel/pipeline.py)
     pp_degree: int = 1
     num_microbatches: int = 1
+    # context parallelism: ring attention over the 'cp' mesh axis; inputs
+    # must be in zig-zag sequence order (parallel/context_parallel.py)
+    cp_degree: int = 1
     # MoE (consumed by fleetx_tpu/parallel/moe.py when num_experts > 1)
     num_experts: int = 1
     expert_mode: bool = False
@@ -134,6 +137,26 @@ class SelfAttention(nn.Module):
             k, v, attn_mask = self._update_cache(k, v, attn_mask)
             causal = False  # the cache mask encodes absolute-position causality
 
+        if cfg.cp_degree > 1 and not decode:
+            # Ring attention: sequence stays sharded over the cp axis; KV
+            # blocks rotate with ppermute (parallel/context_parallel.py).
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "context parallelism does not support a custom attn_mask"
+                )
+            if cfg.attention_probs_dropout_prob > 0.0 and not deterministic:
+                raise NotImplementedError(
+                    "context parallelism requires attention_probs_dropout_prob=0 "
+                    "(hidden dropout is unaffected)"
+                )
+            from fleetx_tpu.parallel.context_parallel import ring_self_attention
+
+            out = ring_self_attention(
+                q, k, v, causal=causal, expected_cp=cfg.cp_degree
+            )
+            out = checkpoint_name(out, "core_attn_out")
+            return self._out_proj(out)
+
         dropout_rng = None
         if cfg.attention_probs_dropout_prob > 0.0 and not deterministic:
             dropout_rng = self.make_rng("dropout")
@@ -149,8 +172,12 @@ class SelfAttention(nn.Module):
             use_flash=cfg.use_flash_attention and not decode,
         )
         out = checkpoint_name(out, "core_attn_out")
+        return self._out_proj(out)
+
+    def _out_proj(self, out):
+        cfg = self.cfg
         out = nn.DenseGeneral(
-            features=h,
+            features=cfg.hidden_size,
             axis=(-2, -1),
             use_bias=True,
             dtype=cfg.dtype,

@@ -80,6 +80,9 @@ class GPTModule(LanguageModule):
             # env.py:103-107)
             extra["pp_degree"] = pp
             extra["num_microbatches"] = max(eng.get("accumulate_steps") or 1, 1)
+        cp = dist.get("cp_degree") or 1
+        if cp > 1:
+            extra["cp_degree"] = cp
         gcfg = GPTConfig(**{**gcfg.__dict__, **extra})
         self.gpt_config = gcfg
         return GPTForPretraining(gcfg)
@@ -88,15 +91,41 @@ class GPTModule(LanguageModule):
         tokens = batch["tokens"]
         return self.nets.init(rng, tokens)
 
+    def cp_prepare(self, batch):
+        """(tokens, position_ids, labels, loss_mask), zig-zag-permuted along
+        the sequence when context parallelism is on.
+
+        Ring attention runs on zig-zag sequence order; tokens/labels/mask are
+        permuted identically and true positions carried explicitly, so the
+        order-invariant masked losses/scores need no un-permute. Every module
+        that feeds the GPT model (pretrain/MoE/eval) must go through here.
+        """
+        tokens = batch["tokens"]
+        position_ids = batch.get("position_ids")
+        labels = batch.get("labels")
+        loss_mask = batch.get("loss_mask")
+        cp = getattr(self.gpt_config, "cp_degree", 1)
+        if cp <= 1:
+            return tokens, position_ids, labels, loss_mask
+        from fleetx_tpu.parallel.context_parallel import zigzag_split
+
+        if position_ids is None:
+            position_ids = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :], tokens.shape
+            )
+        z = lambda x: None if x is None else zigzag_split(x, cp, axis=1)
+        return z(tokens), z(position_ids), z(labels), z(loss_mask)
+
     def loss_fn(self, params, batch, rng, train: bool):
+        tokens, position_ids, labels, loss_mask = self.cp_prepare(batch)
         logits = self.nets.apply(
             {"params": params},
-            batch["tokens"],
-            batch.get("position_ids"),
+            tokens,
+            position_ids,
             deterministic=not train,
             rngs={"dropout": rng} if train and rng is not None else None,
         )
-        loss = pretraining_loss(logits, batch["labels"], batch["loss_mask"])
+        loss = pretraining_loss(logits, labels, loss_mask)
         return loss, {}
 
     def input_spec(self):

@@ -30,22 +30,24 @@ class GPTEvalModule(GPTModule):
     def score_batch(self, params, batch) -> Dict[str, np.ndarray]:
         if self._score_fn is None:
             def score(params, batch):
+                tokens, position_ids, labels, loss_mask = self.cp_prepare(batch)
                 logits = self.nets.apply(
-                    {"params": params}, batch["tokens"], batch.get("position_ids")
+                    {"params": params}, tokens, position_ids
                 ).astype(jnp.float32)
                 logz = jax.nn.logsumexp(logits, axis=-1)
                 tgt = jnp.take_along_axis(
-                    logits, batch["labels"][..., None], axis=-1
+                    logits, labels[..., None], axis=-1
                 )[..., 0]
-                nll = (logz - tgt) * batch["loss_mask"]
+                nll = (logz - tgt) * loss_mask
                 # cloze correctness: every masked target predicted exactly
+                # (per-row any() is order-invariant under the zig-zag permute)
                 pred = jnp.argmax(logits, axis=-1)
-                wrong = ((pred != batch["labels"]) & (batch["loss_mask"] > 0)).any(axis=1)
+                wrong = ((pred != labels) & (loss_mask > 0)).any(axis=1)
                 return {
                     "nll_sum": nll.sum(),
-                    "token_count": batch["loss_mask"].sum(),
+                    "token_count": loss_mask.sum(),
                     "correct": (~wrong).sum(),
-                    "examples": jnp.asarray(batch["tokens"].shape[0], jnp.float32),
+                    "examples": jnp.asarray(tokens.shape[0], jnp.float32),
                 }
 
             self._score_fn = jax.jit(score)

@@ -17,15 +17,16 @@ __all__ = ["MoEModule"]
 
 class MoEModule(GPTModule):
     def loss_fn(self, params, batch, rng, train: bool):
+        tokens, position_ids, labels, loss_mask = self.cp_prepare(batch)
         logits, mutated = self.nets.apply(
             {"params": params},
-            batch["tokens"],
-            batch.get("position_ids"),
+            tokens,
+            position_ids,
             deterministic=not train,
             rngs={"dropout": rng} if train and rng is not None else None,
             mutable=["intermediates"],
         )
-        lm_loss = pretraining_loss(logits, batch["labels"], batch["loss_mask"])
+        lm_loss = pretraining_loss(logits, labels, loss_mask)
         # each MoE layer sows one aux loss (stacked along the scan axis);
         # average over layers so balance_loss_weight is depth-invariant
         balance = jnp.asarray(0.0, jnp.float32)

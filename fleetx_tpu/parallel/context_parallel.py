@@ -1,0 +1,268 @@
+"""Ring-attention context parallelism over a ``cp`` mesh axis.
+
+The reference's only long-sequence mechanism is Megatron sequence parallel
+tied to the TP degree (/root/reference/ppfleetx/models/language_model/gpt/
+dygraph/sequence_parallel_utils.py:40-395) — activations are sharded
+[s/n, b, h] *between* attention/FFN but every rank still materializes the
+full sequence inside attention. This module goes beyond that with true
+context parallelism: the sequence stays sharded *through* attention and
+KV blocks rotate around the ``cp`` ring with ``lax.ppermute`` while each
+device accumulates its queries' output with an online (flash-style)
+softmax. Memory per device is O(s/cp) activations and O(s/cp) KV at a
+time; the [s, s] score matrix never exists.
+
+This is the TPU-native form of Ring Attention (blockwise parallel
+transformers): the permute collective rides the ICI ring, and each hop
+overlaps with the local attention block's compute under XLA async
+collectives.
+
+Causality is handled at block granularity with a zig-zag layout: device i
+holds query/key blocks (i, 2*cp-1-i) of 2*cp equal slices, so every device
+owns one "early" and one "late" block and the causal triangle's work is
+balanced across the ring (a plain contiguous split leaves rank 0 almost
+idle). `zigzag_split`/`zigzag_merge` convert between contiguous and
+zig-zag order on the host or with pure reshapes under jit.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fleetx_tpu.ops.attention import NEG_INF
+
+__all__ = [
+    "ring_attention",
+    "ring_self_attention",
+    "zigzag_split",
+    "zigzag_merge",
+]
+
+
+def _block_scores(q, k, scale):
+    # q [b, sq, h, d] x k [b, sk, h, d] -> [b, h, sq, sk], fp32 accumulate.
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    return s * scale
+
+
+def _online_update(acc, m, l, scores, v):
+    """One flash-attention accumulation step.
+
+    acc [b, h, sq, d] fp32 running numerator, m [b, h, sq] running max,
+    l [b, h, sq] running denominator; scores [b, h, sq, sk] fp32 (already
+    masked); v [b, sk, h, d].
+    """
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    l_new = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    acc_new = acc * corr[..., None] + pv
+    return acc_new, m_new, l_new
+
+
+def _ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool,
+) -> jax.Array:
+    """Per-device body — runs inside shard_map; sequence axis sharded over
+    ``axis_name``. q, k, v: [b, 2, s_blk, h, d] with the two zig-zag blocks
+    stacked on dim 1 (block 0 = "early" slice, block 1 = "late" slice).
+    """
+    cp = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, two, s_blk, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
+
+    # Global block ids of this device's two zig-zag slices.
+    my_blocks = jnp.stack([me, 2 * cp - 1 - me])  # [2]
+
+    acc = jnp.zeros((2, b, h, s_blk, d), jnp.float32)
+    m = jnp.full((2, b, h, s_blk), NEG_INF, jnp.float32)
+    l = jnp.zeros((2, b, h, s_blk), jnp.float32)
+
+    def step(t, carry):
+        acc, m, l, k_cur, v_cur = carry
+        # k_cur/v_cur originated on rank (me - t) mod cp.
+        src = (me - t) % cp
+        kv_blocks = jnp.stack([src, 2 * cp - 1 - src])  # [2]
+
+        def one_pair(qi, acc_i, m_i, l_i):
+            """Attend q block qi (global id my_blocks[qi]) over both kv blocks."""
+            qb = q[:, qi]
+            for kj in range(2):
+                kb, vb = k_cur[:, kj], v_cur[:, kj]
+                scores = _block_scores(qb, kb, scale)
+                if causal:
+                    q_pos = my_blocks[qi] * s_blk + jnp.arange(s_blk)[:, None]
+                    k_pos = kv_blocks[kj] * s_blk + jnp.arange(s_blk)[None, :]
+                    scores = scores + jnp.where(q_pos >= k_pos, 0.0, NEG_INF)
+                acc_i, m_i, l_i = _online_update(acc_i, m_i, l_i, scores, vb)
+            return acc_i, m_i, l_i
+
+        new_acc, new_m, new_l = [], [], []
+        for qi in range(2):
+            a, mm, ll = one_pair(qi, acc[qi], m[qi], l[qi])
+            new_acc.append(a)
+            new_m.append(mm)
+            new_l.append(ll)
+        acc = jnp.stack(new_acc)
+        m = jnp.stack(new_m)
+        l = jnp.stack(new_l)
+
+        # Rotate KV around the ring: rank r hands its buffer to r+1.
+        perm = [(r, (r + 1) % cp) for r in range(cp)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return acc, m, l, k_nxt, v_nxt
+
+    acc, m, l, _, _ = lax.fori_loop(0, cp, step, (acc, m, l, k, v))
+    # l is 0 only if every block was fully masked — impossible for causal
+    # self-attention (the diagonal block always attends), so divide directly.
+    out = acc / l[..., None]  # [2, b, h, s_blk, d]
+    out = jnp.moveaxis(out, 2, 3)  # [2, b, s_blk, h, d]
+    return out.transpose(1, 0, 2, 3, 4).astype(q.dtype)  # [b, 2, s_blk, h, d]
+
+
+def zigzag_split(x: jax.Array, cp: int, axis: int = 1) -> jax.Array:
+    """Contiguous -> zig-zag sequence order. Shape is unchanged; only the
+    order along ``axis`` changes: the sequence is cut into 2*cp equal blocks
+    and reordered to [b0, b_{2cp-1}, b1, b_{2cp-2}, ...], so an even split
+    over cp devices gives device i its pair (b_i, b_{2cp-1-i}) contiguously
+    — one "early" and one "late" block, balancing the causal triangle.
+    """
+    s = x.shape[axis]
+    assert s % (2 * cp) == 0, f"seq {s} not divisible by 2*cp={2*cp}"
+    s_blk = s // (2 * cp)
+    x = jnp.moveaxis(x, axis, 0)
+    blocks = x.reshape((2 * cp, s_blk) + x.shape[1:])
+    order = []
+    for i in range(cp):
+        order += [i, 2 * cp - 1 - i]
+    blocks = blocks[jnp.asarray(order)]
+    out = blocks.reshape((2 * cp * s_blk,) + x.shape[1:])
+    return jnp.moveaxis(out, 0, axis)
+
+
+def zigzag_merge(x: jax.Array, cp: int, axis: int = 1) -> jax.Array:
+    """Inverse of zigzag_split."""
+    s = x.shape[axis]
+    s_blk = s // (2 * cp)
+    x = jnp.moveaxis(x, axis, 0)
+    blocks = x.reshape((2 * cp, s_blk) + x.shape[1:])
+    order = []
+    for i in range(cp):
+        order += [i, 2 * cp - 1 - i]
+    inv = [0] * (2 * cp)
+    for pos, blk in enumerate(order):
+        inv[blk] = pos
+    blocks = blocks[jnp.asarray(inv)]
+    out = blocks.reshape((2 * cp * s_blk,) + x.shape[1:])
+    return jnp.moveaxis(out, 0, axis)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "cp",
+    causal: bool = True,
+) -> jax.Array:
+    """shard_map-interior ring attention.
+
+    Call inside an existing ``shard_map`` whose in_specs shard the sequence
+    axis (dim 1) of [b, s_local*2? ...] — here q/k/v are the *local* shard
+    [b, s_local, h, d] where the global sequence was laid out with
+    :func:`zigzag_split`. s_local must be even (two zig-zag blocks).
+    """
+    b, s_local, h, d = q.shape
+    assert s_local % 2 == 0, "local seq must hold two zig-zag blocks"
+    s_blk = s_local // 2
+    reshape = lambda x: x.reshape(b, 2, s_blk, h, d)
+    out = _ring_attention_local(
+        reshape(q), reshape(k), reshape(v), axis_name=axis_name, causal=causal
+    )
+    return out.reshape(b, s_local, h, d)
+
+
+def _ambient_mesh() -> Optional[Mesh]:
+    try:
+        m = jax.sharding.get_abstract_mesh()  # modern context mesh
+        if m is not None and not m.empty:  # pragma: no cover - version dependent
+            return m
+    except Exception:
+        pass
+    try:
+        from jax.interpreters import pxla
+
+        m = pxla.thread_resources.env.physical_mesh
+        if m is not None and m.devices.size:
+            return m
+    except Exception:  # pragma: no cover
+        pass
+    return None
+
+
+def ring_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Optional[Mesh] = None,
+    cp_axis: str = "cp",
+    batch_axes=("dp", "fsdp"),
+    head_axis: Optional[str] = "mp",
+    causal: bool = True,
+    expected_cp: Optional[int] = None,
+) -> jax.Array:
+    """Ring attention on globally-shaped [b, s, h, d] arrays.
+
+    The sequence must already be in zig-zag order (:func:`zigzag_split`) —
+    the data pipeline does this once (modules' ``cp_prepare``), so all
+    layers see the permuted order (position ids carry the true positions;
+    attention here is the only position-sensitive op).
+
+    Wraps a shard_map over (batch_axes, cp_axis, head_axis); safe to call
+    under jit inside the model — GSPMD sees a sharded custom region.
+
+    ``expected_cp``: when the caller's config promises a cp degree, pass it —
+    a missing/mismatched mesh axis then raises instead of silently running
+    plain causal attention on zig-zag-ordered (i.e. wrongly ordered) data.
+    """
+    if mesh is None:
+        mesh = _ambient_mesh()
+    have_cp = mesh is not None and cp_axis in mesh.shape and mesh.shape[cp_axis] > 1
+    if expected_cp and expected_cp > 1:
+        if not have_cp or mesh.shape[cp_axis] != expected_cp:
+            raise RuntimeError(
+                f"model configured with cp_degree={expected_cp} but the "
+                f"ambient mesh is {None if mesh is None else dict(mesh.shape)}; "
+                "ring attention needs the 'cp' axis (inputs are zig-zag "
+                "ordered — falling back would be silently wrong)"
+            )
+    if not have_cp:
+        # No cp axis in play and none promised: inputs are in natural order,
+        # plain attention is exact.
+        from fleetx_tpu.ops.attention import causal_attention
+
+        return causal_attention(q, k, v, causal=causal)
+
+    spec = P(batch_axes, cp_axis, head_axis, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=cp_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

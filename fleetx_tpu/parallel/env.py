@@ -41,6 +41,11 @@ def init_dist_env(
     (env.py:85-114) — there are no per-strategy process groups to build;
     the Mesh carries all topology.
     """
+    # Honor an explicit JAX_PLATFORMS request even when a sitecustomize or
+    # other early import already pinned a different platform (the env var is
+    # only read at first backend init, so re-pin through the config system).
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     coordinator_address = coordinator_address or os.environ.get("FLEETX_COORDINATOR")
     if num_processes is None and os.environ.get("FLEETX_NUM_PROCESSES"):
         num_processes = int(os.environ["FLEETX_NUM_PROCESSES"])

@@ -10,11 +10,13 @@ inserted by GSPMD from sharding annotations, or written explicitly with
 - ``dp``     data parallel (pure replication of params)
 - ``fsdp``   data parallel with ZeRO param/opt-state sharding (sharding_degree)
 - ``pp``     pipeline stages
+- ``cp``     context parallel (ring attention; sequence sharded through attn)
 - ``mp``     tensor ("model") parallel; sequence parallel rides this axis
 - ``ep``     expert parallel for MoE (folded over dp×fsdp when used)
 
-Mesh axis order is (pp, dp, fsdp, mp): mp innermost so TP collectives ride
-the fastest ICI links, pp outermost so stage p2p can cross DCN.
+Mesh axis order is (pp, dp, fsdp, cp, mp): mp innermost so TP collectives
+ride the fastest ICI links, cp next so the KV ring permute stays on-chip
+neighbors, pp outermost so stage p2p can cross DCN.
 """
 
 from __future__ import annotations
@@ -46,11 +48,12 @@ class MeshConfig:
     fsdp: int = 1
     mp: int = 1
     pp: int = 1
+    cp: int = 1
     sharding_stage: int = 1
 
     @property
     def nranks(self) -> int:
-        return self.dp * self.fsdp * self.mp * self.pp
+        return self.dp * self.fsdp * self.mp * self.pp * self.cp
 
     @classmethod
     def from_dist_config(cls, dist) -> "MeshConfig":
@@ -61,6 +64,7 @@ class MeshConfig:
             fsdp=sharding.get("sharding_degree") or 1,
             mp=dist.get("mp_degree") or 1,
             pp=dist.get("pp_degree") or 1,
+            cp=dist.get("cp_degree") or 1,
             sharding_stage=sharding.get("sharding_stage") or 1,
         )
 
@@ -76,7 +80,7 @@ def build_mesh(
     """
     if devices is None:
         devices = jax.devices()
-    shape = (cfg.pp, cfg.dp, cfg.fsdp, cfg.mp)
+    shape = (cfg.pp, cfg.dp, cfg.fsdp, cfg.cp, cfg.mp)
     if cfg.nranks < len(devices):
         devices = list(devices)[: cfg.nranks]  # sub-mesh of the first N
     if cfg.nranks != len(devices):
@@ -89,7 +93,7 @@ def build_mesh(
         dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
     else:
         dev_array = np.asarray(list(devices)).reshape(shape)
-    return Mesh(dev_array, ("pp", "dp", "fsdp", "mp"))
+    return Mesh(dev_array, ("pp", "dp", "fsdp", "cp", "mp"))
 
 
 def mesh_from_config(cfg, devices=None) -> Mesh:

@@ -156,8 +156,10 @@ def process_dist_config(cfg: AttrDict, nranks: Optional[int] = None) -> AttrDict
         nranks = _device_count()
     mp = dist.mp_degree or 1
     pp = dist.pp_degree or 1
+    cp = dist.cp_degree or 1
     dist.mp_degree = mp
     dist.pp_degree = pp
+    dist.cp_degree = cp
 
     sharding = dist.setdefault_section("sharding")
     sharding.sharding_degree = sharding.sharding_degree or 1
@@ -167,10 +169,10 @@ def process_dist_config(cfg: AttrDict, nranks: Optional[int] = None) -> AttrDict
         raise ValueError(f"sharding_stage must be 1/2/3, got {sharding.sharding_stage}")
     sd = sharding.sharding_degree
 
-    other = mp * pp * sd
+    other = mp * pp * sd * cp
     if nranks % other != 0:
         raise ValueError(
-            f"device count {nranks} not divisible by mp*pp*sharding = {mp}*{pp}*{sd}"
+            f"device count {nranks} not divisible by mp*pp*sharding*cp = {mp}*{pp}*{sd}*{cp}"
         )
     derived_dp = nranks // other
     if dist.dp_degree in (None, ""):
@@ -178,7 +180,7 @@ def process_dist_config(cfg: AttrDict, nranks: Optional[int] = None) -> AttrDict
     dp = dist.dp_degree
     if dp * other != nranks:
         raise ValueError(
-            f"dp({dp}) * mp({mp}) * pp({pp}) * sharding({sd}) = {dp * other} "
+            f"dp({dp}) * mp({mp}) * pp({pp}) * sharding({sd}) * cp({cp}) = {dp * other} "
             f"!= device count {nranks}"
         )
     # Sequence parallel rides the mp axis (Megatron-style); flag lives in Model.
@@ -186,6 +188,12 @@ def process_dist_config(cfg: AttrDict, nranks: Optional[int] = None) -> AttrDict
     if model.get("sequence_parallel") and mp <= 1:
         logger.warning("sequence_parallel=True with mp_degree<=1 has no effect; disabling")
         model["sequence_parallel"] = False
+    if cp > 1 and (model.get("attention_probs_dropout_prob") or 0) > 0:
+        logger.warning(
+            "cp_degree>1 (ring attention) does not support attention dropout; "
+            "forcing attention_probs_dropout_prob=0"
+        )
+        model["attention_probs_dropout_prob"] = 0.0
     return cfg
 
 

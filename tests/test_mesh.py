@@ -13,7 +13,7 @@ from fleetx_tpu.parallel.sharding import make_rules, logical_to_mesh_sharding
 
 def test_mesh_shapes(eight_devices):
     mesh = build_mesh(MeshConfig(dp=2, fsdp=2, mp=2, pp=1))
-    assert mesh.shape == {"pp": 1, "dp": 2, "fsdp": 2, "mp": 2}
+    assert mesh.shape == {"pp": 1, "dp": 2, "fsdp": 2, "cp": 1, "mp": 2}
 
 
 def test_mesh_too_many_devices_needed_raises(eight_devices):
