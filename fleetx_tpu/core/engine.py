@@ -132,6 +132,7 @@ class Trainer:
         self.rules = make_rules(
             sharding_stage=self.mesh_cfg.sharding_stage,
             sequence_parallel=bool((cfg.Model or {}).get("sequence_parallel")),
+            context_parallel=self.mesh_cfg.cp > 1,
         )
 
         self.root_key = dist_env.set_seed(glb.seed)
@@ -346,9 +347,14 @@ class Trainer:
                     break
                 batch = self.module.pretreating_batch(batch)
                 if tokens_per_batch is None:
-                    tokens_per_batch = int(
-                        np.prod(np.asarray(batch["tokens"]).shape)
-                    )
+                    # ips accounting: LM batches carry "tokens", encoder/
+                    # vision batches "input_ids"/first array respectively
+                    arr = batch.get("tokens")
+                    if arr is None:
+                        arr = batch.get("input_ids")
+                    if arr is None:
+                        arr = next(iter(batch.values()))
+                    tokens_per_batch = int(np.prod(np.asarray(arr).shape))
                 device_batch = self._shard_batch(batch)
                 rng = dist_env.data_rank_key(step)
                 self.state, metrics = train_step(self.state, device_batch, rng)

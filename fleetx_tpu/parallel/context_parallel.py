@@ -90,8 +90,7 @@ def _ring_attention_local(
     m = jnp.full((2, b, h, s_blk), NEG_INF, jnp.float32)
     l = jnp.zeros((2, b, h, s_blk), jnp.float32)
 
-    def step(t, carry):
-        acc, m, l, k_cur, v_cur = carry
+    def accumulate(t, acc, m, l, k_cur, v_cur):
         # k_cur/v_cur originated on rank (me - t) mod cp.
         src = (me - t) % cp
         kv_blocks = jnp.stack([src, 2 * cp - 1 - src])  # [2]
@@ -115,17 +114,21 @@ def _ring_attention_local(
             new_acc.append(a)
             new_m.append(mm)
             new_l.append(ll)
-        acc = jnp.stack(new_acc)
-        m = jnp.stack(new_m)
-        l = jnp.stack(new_l)
+        return jnp.stack(new_acc), jnp.stack(new_m), jnp.stack(new_l)
 
+    def step(t, carry):
+        acc, m, l, k_cur, v_cur = carry
+        acc, m, l = accumulate(t, acc, m, l, k_cur, v_cur)
         # Rotate KV around the ring: rank r hands its buffer to r+1.
         perm = [(r, (r + 1) % cp) for r in range(cp)]
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return acc, m, l, k_nxt, v_nxt
 
-    acc, m, l, _, _ = lax.fori_loop(0, cp, step, (acc, m, l, k, v))
+    # cp-1 rotate-and-accumulate steps, then a peeled final accumulate so the
+    # last (unused) KV rotation never hits the ICI.
+    acc, m, l, k_last, v_last = lax.fori_loop(0, cp - 1, step, (acc, m, l, k, v))
+    acc, m, l = accumulate(cp - 1, acc, m, l, k_last, v_last)
     # l is 0 only if every block was fully masked — impossible for causal
     # self-attention (the diagonal block always attends), so divide directly.
     out = acc / l[..., None]  # [2, b, h, s_blk, d]
@@ -140,33 +143,34 @@ def zigzag_split(x: jax.Array, cp: int, axis: int = 1) -> jax.Array:
     over cp devices gives device i its pair (b_i, b_{2cp-1-i}) contiguously
     — one "early" and one "late" block, balancing the causal triangle.
     """
-    s = x.shape[axis]
-    assert s % (2 * cp) == 0, f"seq {s} not divisible by 2*cp={2*cp}"
-    s_blk = s // (2 * cp)
-    x = jnp.moveaxis(x, axis, 0)
-    blocks = x.reshape((2 * cp, s_blk) + x.shape[1:])
-    order = []
-    for i in range(cp):
-        order += [i, 2 * cp - 1 - i]
-    blocks = blocks[jnp.asarray(order)]
-    out = blocks.reshape((2 * cp * s_blk,) + x.shape[1:])
-    return jnp.moveaxis(out, 0, axis)
+    return _permute_blocks(x, cp, axis, invert=False)
 
 
 def zigzag_merge(x: jax.Array, cp: int, axis: int = 1) -> jax.Array:
     """Inverse of zigzag_split."""
-    s = x.shape[axis]
-    s_blk = s // (2 * cp)
-    x = jnp.moveaxis(x, axis, 0)
-    blocks = x.reshape((2 * cp, s_blk) + x.shape[1:])
+    return _permute_blocks(x, cp, axis, invert=True)
+
+
+def _zigzag_order(cp: int):
     order = []
     for i in range(cp):
         order += [i, 2 * cp - 1 - i]
-    inv = [0] * (2 * cp)
-    for pos, blk in enumerate(order):
-        inv[blk] = pos
-    blocks = blocks[jnp.asarray(inv)]
-    out = blocks.reshape((2 * cp * s_blk,) + x.shape[1:])
+    return order
+
+
+def _permute_blocks(x: jax.Array, cp: int, axis: int, invert: bool) -> jax.Array:
+    s = x.shape[axis]
+    assert s % (2 * cp) == 0, f"seq {s} not divisible by 2*cp={2 * cp}"
+    s_blk = s // (2 * cp)
+    order = _zigzag_order(cp)
+    if invert:
+        inv = [0] * (2 * cp)
+        for pos, blk in enumerate(order):
+            inv[blk] = pos
+        order = inv
+    x = jnp.moveaxis(x, axis, 0)
+    blocks = x.reshape((2 * cp, s_blk) + x.shape[1:])
+    out = blocks[jnp.asarray(order)].reshape((2 * cp * s_blk,) + x.shape[1:])
     return jnp.moveaxis(out, 0, axis)
 
 

@@ -40,11 +40,16 @@ def make_rules(
     sharding_stage: int = 1,
     sequence_parallel: bool = False,
     fsdp_params: Optional[bool] = None,
+    context_parallel: bool = False,
 ) -> List[Tuple[str, Any]]:
     """Logical→mesh axis rules.
 
     ``fsdp_params`` overrides whether *parameters* (not just optimizer state)
     are sharded over the fsdp axis; default derives from sharding_stage>=3.
+    ``context_parallel`` puts the activation sequence axis on ``cp`` so the
+    whole layer stack (embeddings, MLP, logits) — not just attention — holds
+    O(s/cp) per device; zig-zag order is position-agnostic for everything
+    outside attention, which re-orders via its own shard_map.
     """
     if fsdp_params is None:
         fsdp_params = sharding_stage >= 3
@@ -68,9 +73,15 @@ def make_rules(
         ("cache_batch", None),
         ("cache_heads", "mp"),
     ]
-    # Activation sequence axis: sharded over mp when sequence_parallel, over
+    # Activation sequence axis: sharded over cp under context parallelism
+    # (optionally also mp for Megatron-SP), over mp alone for pure SP, over
     # nothing otherwise. 'act_seq' only tags activations, never params.
-    rules.append(("act_seq", "mp" if sequence_parallel else None))
+    if context_parallel:
+        rules.append(("act_seq", ("cp", "mp") if sequence_parallel else "cp"))
+    elif sequence_parallel:
+        rules.append(("act_seq", "mp"))
+    else:
+        rules.append(("act_seq", None))
     rules.append(("act_batch", ("dp", "fsdp")))
     rules.append(("act_embed", None))
     return rules
