@@ -340,6 +340,9 @@ class Trainer:
             sampler = getattr(train_data, "batch_sampler", None)
             if sampler is not None and hasattr(sampler, "set_epoch"):
                 sampler.set_epoch(epoch)
+            dataset = getattr(train_data, "dataset", None)
+            if dataset is not None and hasattr(dataset, "set_epoch"):
+                dataset.set_epoch(epoch)  # per-epoch re-masking (ERNIE)
             t_last = time.time()
             loss_window = []
             for batch in train_data:

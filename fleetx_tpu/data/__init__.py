@@ -22,7 +22,9 @@ def _dataset_registry():
     if _BUILTINS_LOADED:
         return _DATASETS
     from fleetx_tpu.data.gpt_dataset import GPTDataset, LMEvalDataset, LambadaEvalDataset
+    from fleetx_tpu.data.ernie_dataset import ErnieDataset
 
+    _DATASETS.setdefault("ErnieDataset", ErnieDataset)
     _DATASETS.setdefault("GPTDataset", GPTDataset)
     _DATASETS.setdefault("LM_Eval_Dataset", LMEvalDataset)
     _DATASETS.setdefault("LMEvalDataset", LMEvalDataset)

@@ -1,0 +1,7 @@
+from fleetx_tpu.models.ernie.model import (  # noqa: F401
+    ErnieConfig,
+    ErnieModel,
+    ErnieForPretraining,
+    ErnieForSequenceClassification,
+    ernie_pretraining_loss,
+)

@@ -1,0 +1,81 @@
+"""ErnieModule — masked-LM + sentence-order-prediction pretraining
+(reference /root/reference/ppfleetx/models/language_model/ernie/
+ernie_module.py:69-160: training_step = lm_loss + sop_loss, ips logging).
+
+Batch contract (static shapes, see fleetx_tpu/data/ernie_dataset.py):
+  input_ids        [b, s] int32 (padded with pad_token_id)
+  token_type_ids   [b, s] int32 (segment A=0 / B=1)
+  masked_positions [b, P] int32 (0-padded slots)
+  masked_labels    [b, P] int32
+  masked_weights   [b, P] float32 (1 for real predictions, 0 for padding)
+  sop_labels       [b]    int32 (1 = segments in order, 0 = swapped)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fleetx_tpu.models.ernie.model import (
+    ErnieConfig,
+    ErnieForPretraining,
+    ernie_pretraining_loss,
+)
+from fleetx_tpu.models.language_module import LanguageModule, resolve_compute_dtype
+
+__all__ = ["ErnieModule"]
+
+
+class ErnieModule(LanguageModule):
+    def get_model(self):
+        model_cfg = self.cfg.Model if hasattr(self.cfg, "Model") else self.cfg
+        ecfg = ErnieConfig.from_model_config(model_cfg)
+        eng = getattr(self.cfg, "Engine", None) or {}
+        ecfg = ErnieConfig(**{**ecfg.__dict__, "dtype": resolve_compute_dtype(eng)})
+        self.ernie_config = ecfg
+        self.binary_head = bool(model_cfg.get("binary_head", True))
+        return ErnieForPretraining(ecfg)
+
+    def init_params(self, rng, batch):
+        return self.nets.init(
+            rng,
+            batch["input_ids"],
+            batch.get("token_type_ids"),
+            masked_positions=batch["masked_positions"],
+        )
+
+    def loss_fn(self, params, batch, rng, train: bool):
+        mlm_logits, sop_logits = self.nets.apply(
+            {"params": params},
+            batch["input_ids"],
+            batch.get("token_type_ids"),
+            None,
+            None,
+            batch["masked_positions"],
+            deterministic=not train,
+            rngs={"dropout": rng} if train and rng is not None else None,
+        )
+        lm_loss, sop_loss = ernie_pretraining_loss(
+            mlm_logits,
+            sop_logits,
+            batch["masked_labels"],
+            batch["masked_weights"],
+            batch.get("sop_labels") if self.binary_head else None,
+        )
+        return lm_loss + sop_loss, {"lm_loss": lm_loss, "sop_loss": sop_loss}
+
+    def input_spec(self):
+        glb = self.cfg.Global
+        data = getattr(self.cfg, "Data", None) or {}
+        ds = ((data.get("Train") or {}).get("dataset") or {}) if data else {}
+        seq = ds.get("max_seq_len") or 512
+        P = ds.get("max_predictions_per_seq") or 80
+        b = glb.micro_batch_size or 1
+        return {
+            "input_ids": jax.ShapeDtypeStruct((b, seq), jnp.int32),
+            "token_type_ids": jax.ShapeDtypeStruct((b, seq), jnp.int32),
+            "masked_positions": jax.ShapeDtypeStruct((b, P), jnp.int32),
+            "masked_labels": jax.ShapeDtypeStruct((b, P), jnp.int32),
+            "masked_weights": jax.ShapeDtypeStruct((b, P), jnp.float32),
+            "sop_labels": jax.ShapeDtypeStruct((b,), jnp.int32),
+        }

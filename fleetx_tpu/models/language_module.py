@@ -56,6 +56,16 @@ class LanguageModule(BasicModule):
         )
 
 
+def resolve_compute_dtype(engine_cfg):
+    """AMP config → compute dtype. fp16 maps to bf16: TPU-native mixed
+    precision needs no loss scaling (the reference's GradScaler + AMP-O2
+    decorate, eager_engine.py:162-172, has no TPU equivalent to need)."""
+    mp = (engine_cfg.get("mix_precision") or {}) if isinstance(engine_cfg, dict) else {}
+    name = mp.get("dtype") or ("bfloat16" if mp.get("use_pure_fp16") else "float32")
+    return {"bfloat16": jnp.bfloat16, "float16": jnp.bfloat16,
+            "float32": jnp.float32}[str(name)]
+
+
 class GPTModule(LanguageModule):
     """GPT pretraining module: batch = (tokens, position_ids, labels,
     loss_mask)."""
@@ -64,14 +74,7 @@ class GPTModule(LanguageModule):
         model_cfg = self.cfg.Model if hasattr(self.cfg, "Model") else self.cfg
         gcfg = GPTConfig.from_model_config(model_cfg)
         eng = getattr(self.cfg, "Engine", None) or {}
-        mp = (eng.get("mix_precision") or {}) if isinstance(eng, dict) else {}
-        # Compute dtype from the AMP config. fp16 maps to bf16: TPU-native
-        # mixed precision needs no loss scaling (reference GradScaler + O2
-        # decorate, eager_engine.py:162-172, has no TPU equivalent to need).
-        name = mp.get("dtype") or ("bfloat16" if mp.get("use_pure_fp16") else "float32")
-        dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.bfloat16,
-                 "float32": jnp.float32}[str(name)]
-        extra = {"dtype": dtype}
+        extra = {"dtype": resolve_compute_dtype(eng)}
         dist = getattr(self.cfg, "Distributed", None) or {}
         pp = dist.get("pp_degree") or 1
         if pp > 1:
