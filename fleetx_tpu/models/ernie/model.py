@@ -31,7 +31,7 @@ from fleetx_tpu.models.gpt.model import (
     _constrain_act,
     _dense,
     _layer_norm,
-    default_kernel_init,
+    attn_out_dense,
 )
 from fleetx_tpu.ops.attention import causal_attention
 
@@ -106,19 +106,7 @@ class ErnieSelfAttention(nn.Module):
             deterministic=deterministic,
             use_flash=False,  # non-causal + padding mask: XLA path
         )
-        out = nn.DenseGeneral(
-            features=cfg.hidden_size,
-            axis=(-2, -1),
-            use_bias=True,
-            dtype=cfg.dtype,
-            param_dtype=jnp.float32,
-            kernel_init=nn.with_logical_partitioning(
-                default_kernel_init, ("heads", "kv", "embed")
-            ),
-            bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), ("norm",)),
-            name="out_proj",
-        )(out)
-        return out
+        return attn_out_dense(cfg.hidden_size, cfg.dtype)(out)
 
 
 class ErnieEncoderLayer(nn.Module):

@@ -110,6 +110,23 @@ def _dense(features, logical_axes, name, use_bias=True, dtype=jnp.bfloat16):
     )
 
 
+def attn_out_dense(hidden_size, dtype, name="out_proj"):
+    """Row-parallel attention output projection [.., heads, kv] -> [.., embed]
+    — shared by GPT/ERNIE/ViT attention blocks."""
+    return nn.DenseGeneral(
+        features=hidden_size,
+        axis=(-2, -1),
+        use_bias=True,
+        dtype=dtype,
+        param_dtype=jnp.float32,
+        kernel_init=nn.with_logical_partitioning(
+            default_kernel_init, ("heads", "kv", "embed")
+        ),
+        bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), ("norm",)),
+        name=name,
+    )
+
+
 class SelfAttention(nn.Module):
     """Causal self-attention with optional fused qkv and kv-cache decode.
 
@@ -176,18 +193,7 @@ class SelfAttention(nn.Module):
 
     def _out_proj(self, out):
         cfg = self.cfg
-        out = nn.DenseGeneral(
-            features=cfg.hidden_size,
-            axis=(-2, -1),
-            use_bias=True,
-            dtype=cfg.dtype,
-            param_dtype=jnp.float32,
-            kernel_init=nn.with_logical_partitioning(
-                default_kernel_init, ("heads", "kv", "embed")
-            ),
-            bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), ("norm",)),
-            name="out_proj",
-        )(out)
+        out = attn_out_dense(cfg.hidden_size, cfg.dtype)(out)
         return checkpoint_name(out, "attn_out")
 
     def _update_cache(self, k, v, attn_mask):

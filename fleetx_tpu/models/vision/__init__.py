@@ -1,0 +1,6 @@
+from fleetx_tpu.models.vision.vit import (  # noqa: F401
+    ViT,
+    ViTConfig,
+    build_vision_model,
+    VIT_PRESETS,
+)

@@ -1,0 +1,89 @@
+"""Convert an image-folder tree (class-per-subdir, ImageNet layout) into the
+``.npz`` format GeneralClsDataset mmaps (reference preprocessing lives in
+ppfleetx/data/transforms; here conversion happens once, offline, so the
+training hosts never touch a million tiny files).
+
+    python tools/preprocess_images.py --input-dir /data/imagenet/train \
+        --output /data/imagenet_npz/train.npz --size 256
+
+Decoding uses PIL when available, else pure-numpy .npy passthrough.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+from fleetx_tpu.utils.log import logger
+
+
+def _load_image(path, size):
+    if path.endswith(".npy"):
+        arr = np.load(path)
+    else:
+        try:
+            from PIL import Image
+        except ImportError as e:
+            raise SystemExit("PIL unavailable; supply .npy images") from e
+        arr = np.asarray(Image.open(path).convert("RGB").resize((size, size)))
+    if arr.shape[:2] != (size, size):
+        ys = (np.arange(size) * arr.shape[0] // size).clip(0, arr.shape[0] - 1)
+        xs = (np.arange(size) * arr.shape[1] // size).clip(0, arr.shape[1] - 1)
+        arr = arr[ys][:, xs]
+    return arr.astype(np.uint8)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input-dir", required=True)
+    ap.add_argument("--output", required=True)
+    ap.add_argument("--size", type=int, default=256)
+    args = ap.parse_args()
+
+    classes = sorted(
+        d for d in os.listdir(args.input_dir)
+        if os.path.isdir(os.path.join(args.input_dir, d))
+    )
+    files = [
+        (os.path.join(args.input_dir, cls, f), li)
+        for li, cls in enumerate(classes)
+        for f in sorted(os.listdir(os.path.join(args.input_dir, cls)))
+    ]
+    # stream into a preallocated memmap: O(1) host memory regardless of
+    # dataset size (a list + np.stack would need ~2x the dataset in RAM)
+    prefix = args.output
+    for suffix in (".npz", ".npy"):
+        if prefix.endswith(suffix):
+            prefix = prefix[: -len(suffix)]
+    os.makedirs(os.path.dirname(os.path.abspath(prefix)) or ".", exist_ok=True)
+    images = np.lib.format.open_memmap(
+        prefix + "_images.npy", mode="w+", dtype=np.uint8,
+        shape=(len(files), args.size, args.size, 3),
+    )
+    labels = np.empty(len(files), np.int64)
+    n = 0
+    for path, li in files:
+        try:
+            images[n] = _load_image(path, args.size)
+            labels[n] = li
+            n += 1
+        except Exception as e:  # unreadable file: skip, keep going
+            logger.warning("skipping %s: %s", path, e)
+    images.flush()
+    np.save(prefix + "_labels.npy", labels[:n])
+    np.save(prefix + "_classes.npy", np.asarray(classes))
+    if n < len(files):
+        logger.warning(
+            "%d unreadable files skipped; %s has %d trailing blank rows "
+            "(labels file has the true count %d)",
+            len(files) - n, prefix + "_images.npy", len(files) - n, n,
+        )
+    logger.info("wrote %d images / %d classes to %s_{images,labels}.npy",
+                n, len(classes), prefix)
+
+
+if __name__ == "__main__":
+    main()
