@@ -41,6 +41,9 @@ class TrainState(struct.PyTreeNode):
     step: jax.Array
     params: Any
     opt_state: Any
+    # module-owned non-parameter training state (e.g. MoCo's momentum
+    # encoder + negative queue); None for ordinary modules
+    extra: Any = None
 
 
 def make_grad_fn(module: "BasicModule", accum: int):
@@ -75,6 +78,30 @@ def make_grad_fn(module: "BasicModule", accum: int):
         (grads, loss_sum, _), _ = jax.lax.scan(micro_step, (zero, 0.0, 0), batch)
         grads = jax.tree.map(lambda g: g / accum, grads)
         return loss_sum / accum, grads
+
+    return compute
+
+
+def make_grad_fn_extra(module: "BasicModule", accum: int):
+    """(params, extra, batch, rng) -> (loss, grads, aux, new_extra) for
+    modules carrying extra train state (MoCo momentum encoder/queue).
+    Extra state updates are inherently sequential, so microbatch grad
+    accumulation is not supported on this path."""
+    if accum != 1:
+        raise NotImplementedError(
+            "accumulate_steps > 1 is not supported for modules with extra "
+            "state (the queue/EMA update order would be ambiguous)"
+        )
+
+    def loss_for(params, extra, batch, rng):
+        loss, aux, new_extra = module.loss_fn_extra(params, extra, batch, rng, train=True)
+        return loss, (aux, new_extra)
+
+    grad_fn = jax.value_and_grad(loss_for, has_aux=True)
+
+    def compute(params, extra, batch, rng):
+        (loss, (aux, new_extra)), grads = grad_fn(params, extra, batch, rng)
+        return loss, grads, aux, new_extra
 
     return compute
 
@@ -155,8 +182,10 @@ class Trainer:
             variables = self.module.init_params(rng, micro)
             params = variables["params"] if "params" in variables else variables
             opt_state = self.tx.init(_unbox(params))
+            extra = self.module.init_extra_state(_unbox(params), micro)
             return TrainState(
-                step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
+                step=jnp.zeros((), jnp.int32), params=params,
+                opt_state=opt_state, extra=extra,
             )
 
         import flax.linen as nn
@@ -205,8 +234,16 @@ class Trainer:
         }
 
         opt_sh = jax.tree.map(opt_shard, abstract.opt_state)
+        # extra state (momentum encoders, queues): same shape-matching rule
+        # as optimizer moments — param-shaped leaves mirror the param
+        # sharding, everything else replicates.
+        extra_sh = (
+            None if abstract.extra is None
+            else jax.tree.map(opt_shard, abstract.extra)
+        )
         return TrainState(
-            step=NamedSharding(self.mesh, P()), params=ps, opt_state=opt_sh
+            step=NamedSharding(self.mesh, P()), params=ps, opt_state=opt_sh,
+            extra=extra_sh,
         )
 
     def _add_fsdp(self, spec: P, shape) -> P:
@@ -223,21 +260,33 @@ class Trainer:
     # ------------------------------------------------------------- train step
     def _build_train_step(self):
         tx = self.tx
-        grads_fn = make_grad_fn(self.module, self.accumulate_steps)
+        if self.state is not None and self.state.extra is not None:
+            grads_fn = make_grad_fn_extra(self.module, self.accumulate_steps)
+        else:
+            grads_fn = make_grad_fn(self.module, self.accumulate_steps)
+
+        module = self.module
 
         def train_step(state: TrainState, batch, rng):
             params = state.params
-            loss, grads = grads_fn(params, batch, rng)
+            if state.extra is not None:
+                loss, grads, aux, new_extra = grads_fn(params, state.extra, batch, rng)
+            else:
+                loss, grads = grads_fn(params, batch, rng)
+                aux, new_extra = {}, None
             updates, new_opt = tx.update(
                 _unbox(grads), state.opt_state, _unbox(params)
             )
             new_params_raw = optax.apply_updates(_unbox(params), updates)
             new_params = _rebox_like(new_params_raw, params)
+            if new_extra is not None:
+                new_extra = module.post_update_extra(new_params_raw, new_extra)
             gnorm = optax.global_norm(_unbox(grads))
             new_state = TrainState(
-                step=state.step + 1, params=new_params, opt_state=new_opt
+                step=state.step + 1, params=new_params, opt_state=new_opt,
+                extra=new_extra,
             )
-            return new_state, {"loss": loss, "grad_norm": gnorm}
+            return new_state, {"loss": loss, "grad_norm": gnorm, **aux}
 
         sh = self._state_sharding_tree
         batch_spec = (
@@ -256,7 +305,12 @@ class Trainer:
         module = self.module
 
         def eval_step(state: TrainState, batch):
-            loss, metrics = module.loss_fn(state.params, batch, None, train=False)
+            if state.extra is not None:
+                loss, metrics, _ = module.loss_fn_extra(
+                    state.params, state.extra, batch, None, train=False
+                )
+            else:
+                loss, metrics = module.loss_fn(state.params, batch, None, train=False)
             return {"loss": loss, **metrics}
 
         sh = self._state_sharding_tree
@@ -498,6 +552,7 @@ class Trainer:
             step=flat.step,
             params=_rebox_like(flat.params, self.state.params),
             opt_state=flat.opt_state,
+            extra=flat.extra,
         )
         meta = restored["meta"]
         self.start_epoch = meta.get("epoch", 0)

@@ -23,10 +23,15 @@ def _dataset_registry():
         return _DATASETS
     from fleetx_tpu.data.gpt_dataset import GPTDataset, LMEvalDataset, LambadaEvalDataset
     from fleetx_tpu.data.ernie_dataset import ErnieDataset
-    from fleetx_tpu.data.vision_dataset import GeneralClsDataset, SyntheticClsDataset
+    from fleetx_tpu.data.vision_dataset import (
+        ContrastiveViewsDataset,
+        GeneralClsDataset,
+        SyntheticClsDataset,
+    )
 
     _DATASETS.setdefault("GeneralClsDataset", GeneralClsDataset)
     _DATASETS.setdefault("SyntheticClsDataset", SyntheticClsDataset)
+    _DATASETS.setdefault("ContrastiveViewsDataset", ContrastiveViewsDataset)
     _DATASETS.setdefault("ErnieDataset", ErnieDataset)
     _DATASETS.setdefault("GPTDataset", GPTDataset)
     _DATASETS.setdefault("LM_Eval_Dataset", LMEvalDataset)

@@ -23,7 +23,7 @@ import numpy as np
 
 from fleetx_tpu.utils.log import logger
 
-__all__ = ["GeneralClsDataset", "SyntheticClsDataset"]
+__all__ = ["GeneralClsDataset", "SyntheticClsDataset", "ContrastiveViewsDataset"]
 
 _IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
 _IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
@@ -129,6 +129,61 @@ class GeneralClsDataset:
         return {
             "images": np.ascontiguousarray(img, np.float32),
             "labels": np.int64(self.labels[i]),
+        }
+
+
+class ContrastiveViewsDataset:
+    """Two independently-augmented views per image for MoCo-style training
+    (reference moco dataset transforms: two random crops + flips). Wraps the
+    same storage as GeneralClsDataset; ``synthetic: True`` generates noise
+    images for benchmarking."""
+
+    def __init__(self, input_dir=None, image_size=224, mode="Train", seed=1234,
+                 num_samples=None, synthetic=False, num_synthetic=1280, **_unused):
+        self.image_size = image_size
+        self.seed = seed
+        self.epoch = 0
+        self.mode = mode
+        self.synthetic = synthetic or input_dir is None
+        if self.synthetic:
+            self._num_samples = num_samples or num_synthetic
+            self.images = None
+        else:
+            base = GeneralClsDataset(
+                input_dir, image_size=image_size, mode=mode, seed=seed,
+                normalize=False,
+            )
+            self.images = base.images
+            self._num_samples = num_samples or len(base.labels)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self):
+        return self._num_samples
+
+    def _view(self, rng, img):
+        out = _random_resized_crop(rng, img, self.image_size)
+        if rng.rand() < 0.5:
+            out = out[:, ::-1]
+        return ((out - _IMAGENET_MEAN) / _IMAGENET_STD).astype(np.float32)
+
+    def __getitem__(self, index):
+        # eval mode: epoch-independent rng so view pairs (and hence the
+        # contrastive loss) are reproducible across runs
+        epoch = self.epoch if self.mode == "Train" else 0
+        rng = np.random.RandomState(
+            (self.seed * 2654435761 + epoch * 97003 + index) % (2**31)
+        )
+        if self.synthetic:
+            img = rng.rand(self.image_size + 16, self.image_size + 16, 3).astype(
+                np.float32
+            )
+        else:
+            img = np.asarray(self.images[index % len(self.images)]).astype(np.float32) / 255.0
+        return {
+            "query": np.ascontiguousarray(self._view(rng, img)),
+            "key": np.ascontiguousarray(self._view(rng, img)),
         }
 
 

@@ -1,0 +1,141 @@
+"""MoCo v1/v2 momentum-contrast pretraining
+(reference /root/reference/ppfleetx/models/vision_model/moco/moco.py:36-235
+and moco_module.py: momentum ("key") encoder updated by EMA, FIFO negative
+queue, InfoNCE loss; v2 adds an MLP projection head).
+
+TPU-first differences from the reference:
+- The key encoder + queue live in ``TrainState.extra`` and are threaded
+  functionally through the jitted step (the reference mutates nn.Layer
+  buffers in-place).
+- No ``concat_all_gather`` (moco.py:36) and no shuffling-BN
+  (_batch_shuffle): under GSPMD the key batch is already a global array, so
+  enqueueing "all-gathers" by construction, and the ResNet/ViT backbones
+  here use GroupNorm, which has no cross-sample statistics to shuffle away.
+
+Batch contract: {"query": [b,H,W,C], "key": [b,H,W,C]} — two augmented
+views (ContrastiveViewsDataset below in fleetx_tpu/data/vision_dataset.py
+emits them).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from fleetx_tpu.models.language_module import resolve_compute_dtype
+from fleetx_tpu.models.module import BasicModule
+from fleetx_tpu.models.vision.resnet import build_resnet
+from fleetx_tpu.models.vision.vit import ViTConfig, ViT
+from fleetx_tpu.utils.log import logger
+
+__all__ = ["MOCOModule"]
+
+
+class MOCOModule(BasicModule):
+    def get_model(self):
+        model_cfg = self.cfg.Model if hasattr(self.cfg, "Model") else self.cfg
+        self.dim = int(model_cfg.get("dim") or 128)
+        self.K = int(model_cfg.get("queue_size") or 65536)
+        self.m = float(model_cfg.get("momentum") or 0.999)
+        self.T = float(model_cfg.get("temperature") or 0.07)
+        self.mlp_head = bool(model_cfg.get("mlp") or False)  # v2
+        eng = getattr(self.cfg, "Engine", None) or {}
+        dtype = resolve_compute_dtype(eng)
+        backbone = model_cfg.get("backbone") or "resnet50"
+
+        import flax.linen as nn
+
+        dim, mlp = self.dim, self.mlp_head
+        is_resnet = str(backbone).startswith("resnet")
+        vit_cfg = None if is_resnet else ViTConfig.from_model_config(
+            {**dict(model_cfg), "num_classes": 0, "dtype": dtype}
+        )
+        resnet_kw = {}
+        if is_resnet and model_cfg.get("width"):
+            resnet_kw["width"] = int(model_cfg["width"])
+
+        class Encoder(nn.Module):
+            """Backbone + projection head -> L2-normalized embeddings."""
+
+            @nn.compact
+            def __call__(self, images):
+                if is_resnet:
+                    h = build_resnet(
+                        str(backbone), num_classes=0, dtype=dtype, **resnet_kw
+                    )(images)
+                else:
+                    h = ViT(vit_cfg, name="vit")(images, deterministic=True)
+                h = h.astype(jnp.float32)
+                if mlp:  # MoCo v2 head
+                    h = nn.Dense(h.shape[-1], name="proj_hidden")(h)
+                    h = nn.relu(h)
+                z = nn.Dense(dim, name="proj_out")(h)
+                return z / jnp.linalg.norm(z, axis=-1, keepdims=True).clip(1e-12)
+
+        return Encoder()
+
+    def init_params(self, rng, batch):
+        return self.nets.init(rng, jnp.asarray(batch["query"]))
+
+    def init_extra_state(self, params, batch):
+        """key-encoder params start as a copy of the query encoder; queue
+        starts as random normalized vectors (reference randn+normalize)."""
+        key0 = jax.random.normal(jax.random.PRNGKey(1234), (self.dim, self.K))
+        key0 = key0 / jnp.linalg.norm(key0, axis=0, keepdims=True).clip(1e-12)
+        return {
+            "key_params": jax.tree.map(jnp.asarray, params),
+            "queue": key0.astype(jnp.float32),
+            "queue_ptr": jnp.zeros((), jnp.int32),
+        }
+
+    def loss_fn_extra(self, params, extra, batch, rng, train: bool):
+        q = self.nets.apply({"params": params}, batch["query"])
+        k = self.nets.apply({"params": extra["key_params"]}, batch["key"])
+        k = jax.lax.stop_gradient(k)
+
+        l_pos = jnp.einsum("nc,nc->n", q, k)[:, None]  # [b, 1]
+        l_neg = jnp.einsum("nc,ck->nk", q, extra["queue"])  # [b, K]
+        logits = jnp.concatenate([l_pos, l_neg], axis=1) / self.T
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        loss = -logp[:, 0].mean()
+        acc = (jnp.argmax(logits, axis=-1) == 0).mean()
+
+        new_extra = dict(extra)
+        if train:
+            # FIFO enqueue: batch is global under GSPMD, so this IS the
+            # all-gathered enqueue of the reference (moco.py concat_all_gather)
+            b = k.shape[0]
+            ptr = extra["queue_ptr"]
+            idx = (ptr + jnp.arange(b)) % self.K
+            new_queue = extra["queue"].at[:, idx].set(k.T.astype(jnp.float32))
+            new_extra["queue"] = new_queue
+            new_extra["queue_ptr"] = (ptr + b) % self.K
+        return loss, {"contrast_acc": acc}, new_extra
+
+    def post_update_extra(self, new_params, extra):
+        m = self.m
+        extra = dict(extra)
+        extra["key_params"] = jax.tree.map(
+            lambda kp, qp: m * kp + (1.0 - m) * qp, extra["key_params"], new_params
+        )
+        return extra
+
+    def loss_fn(self, params, batch, rng, train: bool):
+        raise RuntimeError("MOCOModule uses loss_fn_extra (extra state)")
+
+    def input_spec(self):
+        glb = self.cfg.Global
+        model_cfg = self.cfg.Model
+        size = int(model_cfg.get("image_size") or 224)
+        b = glb.micro_batch_size or 1
+        return {
+            "query": jax.ShapeDtypeStruct((b, size, size, 3), jnp.float32),
+            "key": jax.ShapeDtypeStruct((b, size, size, 3), jnp.float32),
+        }
+
+    def training_step_end(self, log: Dict) -> None:
+        from fleetx_tpu.models.vision_module import log_images_per_sec
+
+        log_images_per_sec(self.cfg, log)

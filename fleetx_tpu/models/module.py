@@ -40,6 +40,22 @@ class BasicModule:
         loss, metrics = self.loss_fn(params, batch, None, train=False)
         return {"loss": loss, **metrics}
 
+    # --- non-parameter training state (MoCo queue/momentum encoder, EMA...)
+    def init_extra_state(self, params, batch):
+        """Return a pytree of extra train state, or None. When not None the
+        engine threads it through ``loss_fn_extra`` and
+        ``post_update_extra`` each step (kept in TrainState.extra,
+        checkpointed alongside params)."""
+        return None
+
+    def loss_fn_extra(self, params, extra, batch, rng, train: bool):
+        """(loss, aux metrics, new_extra) for modules with extra state."""
+        raise NotImplementedError
+
+    def post_update_extra(self, new_params, extra):
+        """Called after the optimizer step (e.g. momentum-encoder EMA)."""
+        return extra
+
     # --- hooks ------------------------------------------------------------
     def pretreating_batch(self, batch):
         """Host-side batch re-pack hook (reference PP repacking,

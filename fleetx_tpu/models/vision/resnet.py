@@ -1,0 +1,115 @@
+"""ResNet backbones (reference MoCo uses paddle.vision resnet50,
+/root/reference/ppfleetx/models/vision_model/moco/moco.py:94-120).
+
+TPU-first choice: GroupNorm instead of BatchNorm. No running statistics
+means no mutable batch_stats collection threading through the engine, and
+MoCo needs no shuffling-BN trick (the reference shuffles keys across GPUs
+purely to stop intra-batch BN statistics leakage, moco.py's
+_batch_shuffle; GroupNorm has no cross-sample statistics to leak)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["ResNet", "ResNetConfig", "RESNET_PRESETS", "build_resnet"]
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)
+    bottleneck: bool = True
+    width: int = 64
+    num_classes: int = 0  # 0 = return pooled features
+    groups: int = 32  # GroupNorm groups
+    dtype: Dtype = jnp.bfloat16
+
+
+RESNET_PRESETS = {
+    "resnet18": dict(stage_sizes=(2, 2, 2, 2), bottleneck=False),
+    "resnet34": dict(stage_sizes=(3, 4, 6, 3), bottleneck=False),
+    "resnet50": dict(stage_sizes=(3, 4, 6, 3), bottleneck=True),
+    "resnet101": dict(stage_sizes=(3, 4, 23, 3), bottleneck=True),
+}
+
+
+def _conv(features, kernel, strides, name, dtype):
+    return nn.Conv(
+        features, (kernel, kernel), (strides, strides),
+        padding="SAME", use_bias=False, dtype=dtype, param_dtype=jnp.float32,
+        name=name,
+    )
+
+
+class _Block(nn.Module):
+    cfg: ResNetConfig
+    features: int
+    strides: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gn = lambda name: nn.GroupNorm(
+            num_groups=min(cfg.groups, self.features), dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name,
+        )
+        residual = x
+        if cfg.bottleneck:
+            y = nn.relu(gn("gn1")(_conv(self.features, 1, 1, "conv1", cfg.dtype)(x)))
+            y = nn.relu(gn("gn2")(_conv(self.features, 3, self.strides, "conv2", cfg.dtype)(y)))
+            out_f = self.features * 4
+            y = nn.GroupNorm(num_groups=min(cfg.groups, out_f), dtype=cfg.dtype,
+                             param_dtype=jnp.float32, name="gn3")(
+                _conv(out_f, 1, 1, "conv3", cfg.dtype)(y)
+            )
+        else:
+            y = nn.relu(gn("gn1")(_conv(self.features, 3, self.strides, "conv1", cfg.dtype)(x)))
+            out_f = self.features
+            y = gn("gn2")(_conv(out_f, 3, 1, "conv2", cfg.dtype)(y))
+        if residual.shape[-1] != out_f or self.strides != 1:
+            residual = nn.GroupNorm(
+                num_groups=min(cfg.groups, out_f), dtype=cfg.dtype,
+                param_dtype=jnp.float32, name="gn_proj",
+            )(_conv(out_f, 1, self.strides, "conv_proj", cfg.dtype)(residual))
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Input [b, H, W, C] channels-last; returns pooled features [b, F] (or
+    logits when num_classes > 0)."""
+
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        x = x.astype(cfg.dtype)
+        x = _conv(cfg.width, 7, 2, "conv_stem", cfg.dtype)(x)
+        x = nn.GroupNorm(num_groups=min(cfg.groups, cfg.width), dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="gn_stem")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(cfg.stage_sizes):
+            for b in range(n_blocks):
+                x = _Block(
+                    cfg,
+                    features=cfg.width * (2 ** stage),
+                    strides=2 if stage > 0 and b == 0 else 1,
+                    name=f"stage{stage}_block{b}",
+                )(x)
+        x = x.mean(axis=(1, 2))  # global average pool
+        if cfg.num_classes:
+            x = nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                         param_dtype=jnp.float32, name="fc")(x.astype(jnp.float32))
+        return x
+
+
+def build_resnet(name: str, **overrides) -> ResNet:
+    if name not in RESNET_PRESETS:
+        raise ValueError(f"unknown resnet {name!r}; have {sorted(RESNET_PRESETS)}")
+    return ResNet(ResNetConfig(**{**RESNET_PRESETS[name], **overrides}))

@@ -203,6 +203,8 @@ class ViT(nn.Module):
             x = _dense(cfg.representation_size, ("embed", None), "pre_logits",
                        dtype=cfg.dtype)(x)
             x = jnp.tanh(x)
+        if cfg.num_classes == 0:  # backbone mode (MoCo etc.): pooled features
+            return x
         logits = _dense(cfg.num_classes, ("embed", None), "head",
                         dtype=jnp.float32)(x.astype(jnp.float32))
         return logits

@@ -23,6 +23,25 @@ from fleetx_tpu.utils.log import logger
 __all__ = ["GeneralClsModule"]
 
 
+def log_images_per_sec(cfg, log: Dict) -> None:
+    """Vision train-log line: images/s global (ips_total) and per-process
+    (the benchmark-parsed ips field). Shared by GeneralClsModule and
+    MOCOModule; the engine's element-count ips is pixels for image batches."""
+    import jax
+
+    images_total = cfg.Global.global_batch_size / max(log["batch_cost"], 1e-9)
+    logger.train(
+        "[train] epoch: %d, batch: %d, loss: %.9f, avg_batch_cost: %.5f sec, "
+        "speed: %.2f step/s, ips_total: %.0f images/s, ips: %.0f images/s, "
+        "learning rate: %.3e",
+        log["epoch"], log["batch"], log["loss"], log["batch_cost"],
+        1.0 / max(log["batch_cost"], 1e-9),
+        images_total,
+        images_total / max(jax.process_count(), 1),
+        log["lr"],
+    )
+
+
 def _soft_ce(logits, targets, label_smoothing=0.0):
     """Cross-entropy with dense (possibly mixed) targets [b, C]."""
     n_cls = logits.shape[-1]
@@ -94,21 +113,7 @@ class GeneralClsModule(BasicModule):
         }
 
     def training_step_end(self, log: Dict) -> None:
-        # The engine's ips counts array elements (pixels for images); report
-        # images/s: global for ips_total, per-process for the parsed ips line.
-        import jax
-
-        images_total = self.cfg.Global.global_batch_size / max(log["batch_cost"], 1e-9)
-        logger.train(
-            "[train] epoch: %d, batch: %d, loss: %.9f, avg_batch_cost: %.5f sec, "
-            "speed: %.2f step/s, ips_total: %.0f images/s, ips: %.0f images/s, "
-            "learning rate: %.3e",
-            log["epoch"], log["batch"], log["loss"], log["batch_cost"],
-            1.0 / max(log["batch_cost"], 1e-9),
-            images_total,
-            images_total / max(jax.process_count(), 1),
-            log["lr"],
-        )
+        log_images_per_sec(self.cfg, log)
 
     def validation_step_end(self, log: Dict) -> None:
         logger.eval(

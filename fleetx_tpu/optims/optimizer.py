@@ -76,7 +76,7 @@ def build_optimizer(
     name = cfg.get("name", "AdamW")
     if lr_schedule is None:
         lr_schedule = build_lr_scheduler(cfg.get("lr", 1e-4))
-    if name not in ("AdamW", "FusedAdamW", "Adam"):
+    if name not in ("AdamW", "FusedAdamW", "Adam", "Momentum", "SGD"):
         raise ValueError(f"unknown optimizer {name!r}")
     if cfg.get("tensor_fusion"):
         logger.info("tensor_fusion requested; XLA fuses collectives natively — ignored")
@@ -90,14 +90,31 @@ def build_optimizer(
 
             return jax.tree_util.tree_map_with_path(decay_ok, params)
 
-    tx = optax.adamw(
-        learning_rate=lr_schedule,
-        b1=cfg.get("beta1", 0.9),
-        b2=cfg.get("beta2", 0.999),
-        eps=cfg.get("epsilon", 1e-8),
-        weight_decay=wd,
-        mask=weight_decay_mask if wd else None,
-    )
+    if name in ("Momentum", "SGD"):
+        # SGD(+momentum) with coupled L2 decay: wd*param joins the gradient
+        # BEFORE the momentum buffer and lr scaling — matching the reference
+        # paddle.optimizer.Momentum(weight_decay=L2Decay) the vision/MoCo
+        # recipes use, not AdamW-style decoupled decay.
+        parts = []
+        if wd:
+            parts.append(optax.add_decayed_weights(wd, mask=weight_decay_mask))
+        parts.append(
+            optax.sgd(
+                learning_rate=lr_schedule,
+                momentum=cfg.get("momentum", 0.9) if name == "Momentum" else None,
+                nesterov=bool(cfg.get("use_nesterov")),
+            )
+        )
+        tx = optax.chain(*parts)
+    else:
+        tx = optax.adamw(
+            learning_rate=lr_schedule,
+            b1=cfg.get("beta1", 0.9),
+            b2=cfg.get("beta2", 0.999),
+            eps=cfg.get("epsilon", 1e-8),
+            weight_decay=wd,
+            mask=weight_decay_mask if wd else None,
+        )
     clip = build_grad_clip(cfg.get("grad_clip"))
     if clip is not None:
         tx = optax.chain(clip, tx)

@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/ernie/pretrain_ernie_base.yaml "$@"

@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/eval.py -c configs/nlp/gpt/eval_gpt_345M_single_card.yaml "$@"

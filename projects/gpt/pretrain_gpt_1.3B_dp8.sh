@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# GPT-1.3B data-parallel over 8 chips. On a TPU pod slice every host runs
+# the same command (jax.distributed discovers peers); no launcher needed.
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/gpt/pretrain_gpt_1.3B_dp8.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# GPT-1.3B at 32k context via ring-attention context parallelism.
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/gpt/pretrain_gpt_1.3B_longcontext_cp8.yaml "$@"

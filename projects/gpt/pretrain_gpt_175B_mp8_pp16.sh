@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# GPT-175B: tp8 x pp16 (+ sequence parallel) over 128 chips.
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/gpt/pretrain_gpt_175B_mp8_pp16.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# GPT-345M single-chip pretraining (reference projects/gpt/pretrain_gpt_345M_single_card.sh)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/gpt/pretrain_gpt_345M_single_card.yaml "$@"

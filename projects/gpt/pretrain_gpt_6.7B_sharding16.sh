@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# GPT-6.7B with ZeRO sharding over 16 chips (reference sharding16 recipe).
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/gpt/pretrain_gpt_6.7B_sharding16.yaml "$@"

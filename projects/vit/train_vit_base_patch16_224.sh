@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/vis/vit/vit_base_patch16_224.yaml "$@"

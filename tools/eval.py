@@ -1,9 +1,21 @@
-"""Offline evaluation entry point (reference /root/reference/tools/eval.py)."""
+"""Offline evaluation entry point (reference /root/reference/tools/eval.py +
+GPTEvalModule, language_module.py:586-703).
 
+Two modes:
+- ``Offline_Eval`` present: WikiText perplexity (overlapping windows) or
+  LAMBADA last-word cloze accuracy (``cloze_eval: True``) over
+  ``eval_path`` — raw text / jsonl (needs ``vocab_dir``) or pre-tokenized
+  ``.npy``.
+- otherwise: mean CE loss over the config's Data.Eval loader.
+"""
+
+import json
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
 
 from fleetx_tpu.core.engine import Trainer
 from fleetx_tpu.data import build_dataloader
@@ -13,10 +25,82 @@ from fleetx_tpu.utils.config import get_config, parse_args
 from fleetx_tpu.utils.log import logger
 
 
+def _batched(dataset, batch_size):
+    """Stack dict samples into fixed-size batches (last partial dropped —
+    matches reference eval batching)."""
+    batch = []
+    for i in range(len(dataset)):
+        batch.append(dataset[i])
+        if len(batch) == batch_size:
+            yield {k: np.stack([s[k] for s in batch]) for k in batch[0]}
+            batch = []
+
+
+def _load_tokens(oe):
+    path = oe["eval_path"]
+    if path.endswith(".npy"):
+        return np.load(path).astype(np.int64)
+    from fleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
+
+    tok = GPTTokenizer.from_pretrained(oe.get("vocab_dir") or "./vocab")
+    with open(path, encoding="utf-8") as f:
+        return np.asarray(tok.encode(f.read()), np.int64)
+
+
+def _lambada_pairs(oe):
+    """jsonl {"text": ...}; target = last whitespace word (reference
+    Lambada_Eval_Dataset tokenization split)."""
+    from fleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
+
+    tok = GPTTokenizer.from_pretrained(oe.get("vocab_dir") or "./vocab")
+    contexts, targets = [], []
+    with open(oe["eval_path"], encoding="utf-8") as f:
+        for line in f:
+            if not line.strip():
+                continue
+            text = json.loads(line)["text"]
+            ctx, _, last = text.rpartition(" ")
+            contexts.append(tok.encode(ctx))
+            targets.append(tok.encode(" " + last))
+    return contexts, targets
+
+
+def offline_eval(cfg):
+    from fleetx_tpu.data.gpt_dataset import LMEvalDataset, LambadaEvalDataset
+
+    oe = cfg.Offline_Eval
+    seq_len = oe.get("max_seq_len") or 1024
+    batch_size = oe.get("batch_size") or 8
+    module = build_module(cfg)
+
+    if oe.get("cloze_eval"):
+        contexts, targets = _lambada_pairs(oe)
+        ds = LambadaEvalDataset(contexts, targets, seq_len, pad_id=0)
+    else:
+        ds = LMEvalDataset(
+            _load_tokens(oe), seq_len, pad_id=0,
+            overlapping_eval=oe.get("overlapping_eval"),
+        )
+
+    trainer = Trainer(cfg, module, mode="eval")
+    first = next(_batched(ds, batch_size))
+    trainer.init_state(first)
+    if (cfg.Engine.save_load or {}).get("ckpt_dir"):
+        trainer.load()
+    result = module.evaluate_dataset(
+        trainer.state.params, _batched(ds, batch_size)
+    )
+    logger.info("offline eval (%s): %s", module.eval_type, result)
+    return result
+
+
 def main():
     args = parse_args()
     init_dist_env()
     cfg = get_config(args.config, overrides=args.override, show=False)
+    if cfg.get("Offline_Eval"):
+        offline_eval(cfg)
+        return
     module = build_module(cfg)
     loader = build_dataloader(cfg, "Eval")
     trainer = Trainer(cfg, module, mode="eval")
