@@ -32,6 +32,9 @@ def _dataset_registry():
     _DATASETS.setdefault("GeneralClsDataset", GeneralClsDataset)
     _DATASETS.setdefault("SyntheticClsDataset", SyntheticClsDataset)
     _DATASETS.setdefault("ContrastiveViewsDataset", ContrastiveViewsDataset)
+    from fleetx_tpu.data.glue_dataset import GlueDataset
+
+    _DATASETS.setdefault("GlueDataset", GlueDataset)
     _DATASETS.setdefault("ErnieDataset", ErnieDataset)
     _DATASETS.setdefault("GPTDataset", GPTDataset)
     _DATASETS.setdefault("LM_Eval_Dataset", LMEvalDataset)

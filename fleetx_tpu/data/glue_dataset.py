@@ -1,0 +1,153 @@
+"""GLUE finetuning datasets (reference /root/reference/ppfleetx/data/
+dataset/glue_dataset.py, 841 LoC of per-task TSV readers + tokenization).
+
+Tasks carry (columns, num_classes, regression, metric) — the TSV layouts of
+the standard GLUE release. Text is BPE-tokenized (GPTTokenizer) and packed
+to ``max_seq_len`` with the actual length kept so the classification head
+pools the last real token. ``synthetic: True`` generates label-correlated
+token streams for CI (zero-egress: no GLUE download here)."""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Optional
+
+import numpy as np
+
+from fleetx_tpu.utils.log import logger
+
+__all__ = ["GlueDataset", "GLUE_TASKS"]
+
+# task -> sentence columns (train/dev), label column, classes, metric; the
+# standard GLUE TSV layouts. test.tsv ships (index, sentence...) WITHOUT
+# labels -> test_cols; dev_file covers MNLI's dev_matched/dev_mismatched.
+GLUE_TASKS = {
+    "sst2": dict(cols=(0,), label=1, num_classes=2, regression=False,
+                 metric="Accuracy", test_cols=(1,), has_header=True),
+    "cola": dict(cols=(3,), label=1, num_classes=2, regression=False,
+                 metric="Mcc", test_cols=(1,), has_header=False,
+                 test_has_header=True),
+    "mrpc": dict(cols=(3, 4), label=0, num_classes=2, regression=False,
+                 metric="AccuracyAndF1", test_cols=(3, 4), has_header=True),
+    "qqp": dict(cols=(3, 4), label=5, num_classes=2, regression=False,
+                metric="AccuracyAndF1", test_cols=(1, 2), has_header=True),
+    "stsb": dict(cols=(7, 8), label=9, num_classes=1, regression=True,
+                 metric="PearsonAndSpearman", test_cols=(7, 8), has_header=True),
+    "mnli": dict(cols=(8, 9), label=11, num_classes=3, regression=False,
+                 metric="Accuracy", test_cols=(8, 9), has_header=True,
+                 dev_file="dev_matched.tsv", test_file="test_matched.tsv",
+                 label_map={"contradiction": 0, "entailment": 1, "neutral": 2}),
+    "qnli": dict(cols=(1, 2), label=3, num_classes=2, regression=False,
+                 metric="Accuracy", test_cols=(1, 2), has_header=True,
+                 label_map={"entailment": 0, "not_entailment": 1}),
+    "rte": dict(cols=(1, 2), label=3, num_classes=2, regression=False,
+                metric="Accuracy", test_cols=(1, 2), has_header=True,
+                label_map={"entailment": 0, "not_entailment": 1}),
+    "wnli": dict(cols=(1, 2), label=3, num_classes=2, regression=False,
+                 metric="Accuracy", test_cols=(1, 2), has_header=True),
+}
+
+
+class GlueDataset:
+    def __init__(
+        self,
+        task: str,
+        input_dir: Optional[str] = None,
+        max_seq_len: int = 128,
+        mode: str = "Train",
+        seed: int = 1234,
+        vocab_dir: Optional[str] = None,
+        synthetic: bool = False,
+        num_samples: Optional[int] = None,
+        vocab_size: int = 50304,
+        pad_id: int = 0,
+        **_unused,
+    ):
+        task = task.lower().replace("-", "")
+        if task not in GLUE_TASKS:
+            raise ValueError(f"unknown GLUE task {task!r}; have {sorted(GLUE_TASKS)}")
+        self.task = task
+        self.spec = GLUE_TASKS[task]
+        self.max_seq_len = max_seq_len
+        self.pad_id = pad_id
+        self.mode = mode
+        self.seed = seed
+
+        if synthetic or input_dir is None:
+            self._init_synthetic(num_samples or 256, vocab_size)
+            return
+
+        spec = self.spec
+        fname = {
+            "Train": "train.tsv",
+            "Eval": spec.get("dev_file", "dev.tsv"),
+            "Test": spec.get("test_file", "test.tsv"),
+        }[mode]
+        path = os.path.join(input_dir, fname)
+        from fleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
+
+        tok = GPTTokenizer.from_pretrained(vocab_dir or os.path.join(input_dir, "vocab"))
+        self.samples = []
+        label_map = spec.get("label_map")
+        is_test = mode == "Test"  # no labels in GLUE test splits
+        cols = spec["test_cols"] if is_test else spec["cols"]
+        has_header = spec.get("test_has_header", True) if is_test else spec["has_header"]
+        with open(path, encoding="utf-8") as f:
+            reader = csv.reader(f, delimiter="\t", quotechar=None)
+            for i, row in enumerate(reader):
+                if i == 0 and has_header:
+                    continue
+                try:
+                    texts = [row[c] for c in cols]
+                    raw = None if is_test else row[spec["label"]]
+                except IndexError:
+                    continue  # malformed line
+                if is_test:
+                    label = -1
+                elif spec["regression"]:
+                    label = float(raw)
+                elif label_map:
+                    label = label_map[raw]
+                else:
+                    label = int(raw)
+                ids = tok.encode(" ".join(texts))[: max_seq_len]
+                self.samples.append((np.asarray(ids, np.int64), label))
+        self._num_samples = num_samples or len(self.samples)
+        logger.info("GlueDataset[%s/%s]: %d examples", task, mode, len(self.samples))
+
+    def _init_synthetic(self, n, vocab_size):
+        """Label-correlated synthetic data: class k drawn from a k-shifted
+        token range, so a real model can actually fit it (CI sanity)."""
+        rng = np.random.RandomState(self.seed)
+        self.samples = []
+        ncls = self.spec["num_classes"]
+        # disjoint token bands per class (band width scales with vocab)
+        band = max((vocab_size - 1) // max(ncls, 2), 2)
+        for _ in range(n):
+            if self.spec["regression"]:
+                label = float(rng.rand() * 5)
+                lo = 1 + int(label / 5.0 * (vocab_size - band - 1))
+            else:
+                label = int(rng.randint(ncls))
+                lo = 1 + label * band
+            length = rng.randint(8, self.max_seq_len)
+            ids = rng.randint(lo, min(lo + band, vocab_size), size=length)
+            self.samples.append((ids.astype(np.int64), label))
+        self._num_samples = n
+
+    def __len__(self):
+        return self._num_samples
+
+    def __getitem__(self, index):
+        ids, label = self.samples[index % len(self.samples)]
+        n = min(len(ids), self.max_seq_len)
+        tokens = np.full(self.max_seq_len, self.pad_id, np.int64)
+        tokens[:n] = ids[:n]
+        return {
+            "tokens": tokens,
+            "seq_lens": np.int64(n),
+            "labels": (
+                np.float32(label) if self.spec["regression"] else np.int64(label)
+            ),
+        }

@@ -441,6 +441,29 @@ class GPTForPretraining(nn.Module):
         return logits
 
 
+class GPTForSequenceClassification(nn.Module):
+    """Classification over the last non-pad token's hidden state (reference
+    GPTForSequenceClassification, single_model.py:739-778: score head,
+    gather at sequence end)."""
+
+    cfg: GPTConfig
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, position_ids=None, attn_mask=None,
+                 seq_lens=None, *, deterministic=True):
+        x = GPTModel(self.cfg, name="gpt")(
+            input_ids, position_ids, attn_mask, deterministic=deterministic
+        )
+        if seq_lens is None:
+            last = jnp.full((input_ids.shape[0],), input_ids.shape[1] - 1, jnp.int32)
+        else:
+            last = jnp.maximum(seq_lens - 1, 0).astype(jnp.int32)
+        pooled = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+        return _dense(self.num_classes, ("embed", None), "score",
+                      dtype=jnp.float32, use_bias=False)(pooled.astype(jnp.float32))
+
+
 def pretraining_loss(logits: jax.Array, labels: jax.Array, loss_mask: jax.Array):
     """Masked LM cross-entropy (reference GPTPretrainingCriterion,
     single_model.py:702-736; the TP ParallelCrossEntropy variant

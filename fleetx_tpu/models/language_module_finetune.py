@@ -1,0 +1,125 @@
+"""GPTFinetuneModule — GLUE sequence-classification finetuning
+(reference /root/reference/ppfleetx/models/language_model/
+language_module.py:222-483: per-task loss from config, metric classes,
+pretrained-checkpoint loading with fused/split qkv conversion).
+
+Loss: CE for classification, MSE for regression (STS-B); metric built from
+``Model.metric`` (fleetx_tpu/models/metrics.py). Pretrained weights load
+through the engine's ckpt_dir mechanism; qkv layout conversion is
+unnecessary here — there is exactly one fused-qkv layout in this framework.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fleetx_tpu.models.gpt.model import GPTConfig, GPTForSequenceClassification
+from fleetx_tpu.models.language_module import LanguageModule, resolve_compute_dtype
+from fleetx_tpu.models.metrics import build_metric
+from fleetx_tpu.utils.log import logger
+
+__all__ = ["GPTFinetuneModule"]
+
+
+class GPTFinetuneModule(LanguageModule):
+    """Batch: {"tokens": [b,s], "seq_lens": [b], "labels": [b]}."""
+
+    def get_model(self):
+        model_cfg = self.cfg.Model if hasattr(self.cfg, "Model") else self.cfg
+        gcfg = GPTConfig.from_model_config(model_cfg)
+        eng = getattr(self.cfg, "Engine", None) or {}
+        gcfg = GPTConfig(**{**gcfg.__dict__, "dtype": resolve_compute_dtype(eng)})
+        self.gpt_config = gcfg
+
+        # Task metadata: the GLUE task spec (num_classes/regression/metric)
+        # is the source of truth when the data section names a GlueDataset
+        # task; explicit Model settings override.
+        spec = {}
+        data = getattr(self.cfg, "Data", None) or {}
+        ds = ((data.get("Train") or {}).get("dataset") or {}) if data else {}
+        if ds.get("name") == "GlueDataset" and ds.get("task"):
+            from fleetx_tpu.data.glue_dataset import GLUE_TASKS
+
+            spec = GLUE_TASKS.get(str(ds["task"]).lower().replace("-", ""), {})
+        self.num_classes = int(
+            model_cfg.get("num_classes") or spec.get("num_classes") or 2
+        )
+        self.regression = bool(
+            model_cfg["regression"] if model_cfg.get("regression") is not None
+            else spec.get("regression")
+        )
+        metric_cfg = model_cfg.get("metric") or spec.get("metric") or {"name": "Accuracy"}
+        if isinstance(metric_cfg, str):
+            metric_cfg = {"name": metric_cfg}
+        self.metric = build_metric(metric_cfg)
+        return GPTForSequenceClassification(
+            gcfg, num_classes=1 if self.regression else self.num_classes
+        )
+
+    def init_params(self, rng, batch):
+        return self.nets.init(
+            rng, batch["tokens"], seq_lens=batch.get("seq_lens")
+        )
+
+    def loss_fn(self, params, batch, rng, train: bool):
+        logits = self.nets.apply(
+            {"params": params},
+            batch["tokens"],
+            None,
+            None,
+            batch.get("seq_lens"),
+            deterministic=not train,
+            rngs={"dropout": rng} if train and rng is not None else None,
+        )
+        labels = batch["labels"]
+        if self.regression:
+            preds = logits[:, 0]
+            loss = jnp.mean((preds - labels.astype(jnp.float32)) ** 2)
+            acc = -loss  # surrogate running metric
+        else:
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            loss = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+            acc = (jnp.argmax(logits, axis=-1) == labels).mean()
+        return loss, {"acc": acc}
+
+    # --------------------------------------------------------------- metric
+    def predict_logits(self, params, batch):
+        if not hasattr(self, "_predict_fn"):
+            self._predict_fn = jax.jit(
+                lambda p, t, sl: self.nets.apply({"params": p}, t, None, None, sl)
+            )
+        return self._predict_fn(params, batch["tokens"], batch["seq_lens"])
+
+    def evaluate_dataset(self, params, loader) -> Dict[str, float]:
+        """Full-metric eval (reference validation_step_end metric accumulate)."""
+        self.metric.reset()
+        n = 0
+        for batch in loader:
+            logits = np.asarray(self.predict_logits(params, batch))
+            preds = logits[:, 0] if self.regression else logits
+            self.metric.update(preds, np.asarray(batch["labels"]))
+            n += logits.shape[0]
+        vals = self.metric.accumulate()
+        if not isinstance(vals, tuple):
+            vals = (vals,)
+        result = {"metric": vals if len(vals) > 1 else vals[0], "examples": n}
+        logger.info("GLUE eval: %s", result)
+        return result
+
+    def input_spec(self):
+        glb = self.cfg.Global
+        data = getattr(self.cfg, "Data", None) or {}
+        ds = ((data.get("Train") or {}).get("dataset") or {}) if data else {}
+        seq = ds.get("max_seq_len") or 128
+        b = glb.micro_batch_size or 1
+        return {
+            "tokens": jax.ShapeDtypeStruct((b, seq), jnp.int32),
+            "seq_lens": jax.ShapeDtypeStruct((b,), jnp.int32),
+            "labels": jax.ShapeDtypeStruct(
+                (b,), jnp.float32 if self.regression else jnp.int32
+            ),
+        }

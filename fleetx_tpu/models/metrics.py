@@ -1,0 +1,204 @@
+"""GLUE metrics — accumulate/compute ports of the reference metric classes
+(/root/reference/ppfleetx/models/language_model/metrics.py:31-692:
+AccuracyAndF1, Mcc, PearsonAndSpearman, MultiLabelsMetric), reimplemented in
+numpy with the same update/accumulate contract: ``update(preds, labels)``
+per batch, ``accumulate()`` for the final value(s), ``reset()`` between
+epochs. Metrics run host-side on gathered outputs — no reason to burn MXU
+cycles on confusion-matrix bookkeeping."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+__all__ = ["Accuracy", "AccuracyAndF1", "Mcc", "PearsonAndSpearman",
+           "MultiLabelsMetric", "build_metric"]
+
+
+def _to_pred_labels(preds: np.ndarray) -> np.ndarray:
+    preds = np.asarray(preds)
+    return preds.argmax(axis=-1) if preds.ndim > 1 else preds
+
+
+class Accuracy:
+    def __init__(self, **_):
+        self.reset()
+
+    def reset(self):
+        self.correct = 0
+        self.total = 0
+
+    def update(self, preds, labels):
+        p = _to_pred_labels(preds)
+        l = np.asarray(labels).reshape(p.shape)
+        self.correct += int((p == l).sum())
+        self.total += p.size
+
+    def accumulate(self) -> float:
+        return self.correct / max(self.total, 1)
+
+
+class AccuracyAndF1:
+    """(acc, precision, recall, f1, (acc+f1)/2) — reference metrics.py:31-178
+    (binary tasks: positive class = 1)."""
+
+    def __init__(self, pos_label: int = 1, **_):
+        self.pos_label = pos_label
+        self.reset()
+
+    def reset(self):
+        self.tp = self.fp = self.fn = 0
+        self.correct = 0
+        self.total = 0
+
+    def update(self, preds, labels):
+        p = _to_pred_labels(preds)
+        l = np.asarray(labels).reshape(p.shape)
+        pos = self.pos_label
+        self.tp += int(((p == pos) & (l == pos)).sum())
+        self.fp += int(((p == pos) & (l != pos)).sum())
+        self.fn += int(((p != pos) & (l == pos)).sum())
+        self.correct += int((p == l).sum())
+        self.total += p.size
+
+    def accumulate(self) -> Tuple[float, float, float, float, float]:
+        acc = self.correct / max(self.total, 1)
+        precision = self.tp / max(self.tp + self.fp, 1)
+        recall = self.tp / max(self.tp + self.fn, 1)
+        f1 = (
+            2 * precision * recall / (precision + recall)
+            if precision + recall > 0
+            else 0.0
+        )
+        return acc, precision, recall, f1, (acc + f1) / 2
+
+
+class Mcc:
+    """Matthews correlation coefficient (CoLA) — reference metrics.py:180-303."""
+
+    def __init__(self, **_):
+        self.reset()
+
+    def reset(self):
+        self.tp = self.fp = self.tn = self.fn = 0
+
+    def update(self, preds, labels):
+        p = _to_pred_labels(preds)
+        l = np.asarray(labels).reshape(p.shape)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+        self.tn += int(((p == 0) & (l == 0)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def accumulate(self) -> Tuple[float]:
+        tp, fp, tn, fn = self.tp, self.fp, self.tn, self.fn
+        denom = np.sqrt(
+            float(tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)
+        )
+        return ((tp * tn - fp * fn) / denom if denom else 0.0,)
+
+
+class PearsonAndSpearman:
+    """(pearson, spearman, mean) for regression (STS-B) — reference
+    metrics.py:305-443."""
+
+    def __init__(self, **_):
+        self.reset()
+
+    def reset(self):
+        self.preds = []
+        self.labels = []
+
+    def update(self, preds, labels):
+        p = np.asarray(preds).reshape(-1)
+        self.preds.append(p.astype(np.float64))
+        self.labels.append(np.asarray(labels).reshape(-1).astype(np.float64))
+
+    @staticmethod
+    def _pearson(a, b):
+        a = a - a.mean()
+        b = b - b.mean()
+        denom = np.sqrt((a * a).sum() * (b * b).sum())
+        return float((a * b).sum() / denom) if denom else 0.0
+
+    @staticmethod
+    def _rank(x):
+        order = np.argsort(x)
+        ranks = np.empty_like(order, dtype=np.float64)
+        ranks[order] = np.arange(len(x), dtype=np.float64)
+        # average ties
+        uniq, inv, counts = np.unique(x, return_inverse=True, return_counts=True)
+        sums = np.zeros(len(uniq))
+        np.add.at(sums, inv, ranks)
+        return sums[inv] / counts[inv]
+
+    def accumulate(self) -> Tuple[float, float, float]:
+        p = np.concatenate(self.preds) if self.preds else np.zeros(0)
+        l = np.concatenate(self.labels) if self.labels else np.zeros(0)
+        if len(p) < 2:
+            return 0.0, 0.0, 0.0
+        pearson = self._pearson(p, l)
+        spearman = self._pearson(self._rank(p), self._rank(l))
+        return pearson, spearman, (pearson + spearman) / 2
+
+
+class MultiLabelsMetric:
+    """Macro/micro precision/recall/F1 over multi-class predictions —
+    reference metrics.py:445-692 (used by token/sequence multi-label
+    tasks)."""
+
+    def __init__(self, num_labels: int, **_):
+        assert num_labels > 1
+        self.num_labels = num_labels
+        self.reset()
+
+    def reset(self):
+        n = self.num_labels
+        self.tp = np.zeros(n, np.int64)
+        self.fp = np.zeros(n, np.int64)
+        self.fn = np.zeros(n, np.int64)
+
+    def update(self, preds, labels):
+        p = _to_pred_labels(preds)
+        l = np.asarray(labels).reshape(p.shape)
+        for c in range(self.num_labels):
+            self.tp[c] += int(((p == c) & (l == c)).sum())
+            self.fp[c] += int(((p == c) & (l != c)).sum())
+            self.fn[c] += int(((p != c) & (l == c)).sum())
+
+    def accumulate(self, average: str = "macro") -> Tuple[float, float, float]:
+        tp, fp, fn = self.tp, self.fp, self.fn
+        if average == "micro":
+            precision = tp.sum() / max(tp.sum() + fp.sum(), 1)
+            recall = tp.sum() / max(tp.sum() + fn.sum(), 1)
+            f1 = (
+                2 * precision * recall / (precision + recall)
+                if precision + recall > 0
+                else 0.0
+            )
+            return float(precision), float(recall), float(f1)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            prec_c = np.where(tp + fp > 0, tp / np.maximum(tp + fp, 1), 0.0)
+            rec_c = np.where(tp + fn > 0, tp / np.maximum(tp + fn, 1), 0.0)
+            f1_c = np.where(
+                prec_c + rec_c > 0, 2 * prec_c * rec_c / np.maximum(prec_c + rec_c, 1e-12), 0.0
+            )
+        return float(prec_c.mean()), float(rec_c.mean()), float(f1_c.mean())
+
+
+_METRICS = {
+    "Accuracy": Accuracy,
+    "AccuracyAndF1": AccuracyAndF1,
+    "Mcc": Mcc,
+    "PearsonAndSpearman": PearsonAndSpearman,
+    "MultiLabelsMetric": MultiLabelsMetric,
+}
+
+
+def build_metric(cfg):
+    cfg = dict(cfg or {})
+    name = cfg.pop("name", "Accuracy")
+    if name not in _METRICS:
+        raise ValueError(f"unknown metric {name!r}; have {sorted(_METRICS)}")
+    return _METRICS[name](**cfg)

@@ -49,9 +49,17 @@ class MOCOModule(BasicModule):
 
         dim, mlp = self.dim, self.mlp_head
         is_resnet = str(backbone).startswith("resnet")
-        vit_cfg = None if is_resnet else ViTConfig.from_model_config(
-            {**dict(model_cfg), "num_classes": 0, "dtype": dtype}
-        )
+        if is_resnet:
+            vit_cfg = None
+        else:
+            from fleetx_tpu.models.vision.vit import VIT_PRESETS
+
+            preset = VIT_PRESETS.get(str(backbone), {})
+            vit_cfg = ViTConfig.from_model_config(
+                {**preset, **{k: v for k, v in dict(model_cfg).items()
+                              if v is not None},
+                 "num_classes": 0, "dtype": dtype}
+            )
         resnet_kw = {}
         if is_resnet and model_cfg.get("width"):
             resnet_kw["width"] = int(model_cfg["width"])
