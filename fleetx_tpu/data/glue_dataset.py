@@ -34,9 +34,12 @@ GLUE_TASKS = {
                 metric="AccuracyAndF1", test_cols=(1, 2), has_header=True),
     "stsb": dict(cols=(7, 8), label=9, num_classes=1, regression=True,
                  metric="PearsonAndSpearman", test_cols=(7, 8), has_header=True),
-    "mnli": dict(cols=(8, 9), label=11, num_classes=3, regression=False,
-                 metric="Accuracy", test_cols=(8, 9), has_header=True,
-                 dev_file="dev_matched.tsv", test_file="test_matched.tsv",
+    # MNLI dev has 16 columns: label1-5 at 10-14, gold_label at 15 (train's
+    # gold_label sits at 11) -> per-split label column
+    "mnli": dict(cols=(8, 9), label=11, eval_label=15, num_classes=3,
+                 regression=False, metric="Accuracy", test_cols=(8, 9),
+                 has_header=True, dev_file="dev_matched.tsv",
+                 test_file="test_matched.tsv",
                  label_map={"contradiction": 0, "entailment": 1, "neutral": 2}),
     "qnli": dict(cols=(1, 2), label=3, num_classes=2, regression=False,
                  metric="Accuracy", test_cols=(1, 2), has_header=True,
@@ -92,6 +95,9 @@ class GlueDataset:
         label_map = spec.get("label_map")
         is_test = mode == "Test"  # no labels in GLUE test splits
         cols = spec["test_cols"] if is_test else spec["cols"]
+        label_col = (
+            spec.get("eval_label", spec["label"]) if mode == "Eval" else spec["label"]
+        )
         has_header = spec.get("test_has_header", True) if is_test else spec["has_header"]
         with open(path, encoding="utf-8") as f:
             reader = csv.reader(f, delimiter="\t", quotechar=None)
@@ -100,7 +106,7 @@ class GlueDataset:
                     continue
                 try:
                     texts = [row[c] for c in cols]
-                    raw = None if is_test else row[spec["label"]]
+                    raw = None if is_test else row[label_col]
                 except IndexError:
                     continue  # malformed line
                 if is_test:

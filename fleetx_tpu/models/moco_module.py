@@ -54,7 +54,15 @@ class MOCOModule(BasicModule):
         else:
             from fleetx_tpu.models.vision.vit import VIT_PRESETS
 
-            preset = VIT_PRESETS.get(str(backbone), {})
+            if str(backbone) in VIT_PRESETS:
+                preset = VIT_PRESETS[str(backbone)]
+            elif str(backbone).lower() == "vit":
+                preset = {}  # dimensions come from Model config directly
+            else:
+                raise ValueError(
+                    f"unknown MoCo backbone {backbone!r}; have resnet* / "
+                    f"'vit' / {sorted(VIT_PRESETS)}"
+                )
             vit_cfg = ViTConfig.from_model_config(
                 {**preset, **{k: v for k, v in dict(model_cfg).items()
                               if v is not None},

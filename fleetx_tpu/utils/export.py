@@ -1,0 +1,125 @@
+"""Model export (reference /root/reference/ppfleetx/utils/export.py:44 —
+``paddle.jit.to_static`` + prune + save, consumed by InferenceEngine).
+
+TPU-native artifact, one directory:
+
+    export_dir/
+      config.yaml         # Model/Generation config to rebuild the module
+      params/             # orbax checkpoint of inference params
+      forward.stablehlo   # jit-lowered StableHLO of the forward fn
+      input_spec.json     # shapes/dtypes the export was traced with
+
+StableHLO is the portable compiled-graph format (what ``to_static``'s
+program is to paddle.inference); any XLA runtime — and jax2tf / IREE
+pipelines — can consume it. Serving-side, InferenceEngine
+(fleetx_tpu/core/inference_engine.py) AOT-compiles from config+params;
+TensorRT has no TPU analogue (XLA is the optimizing backend).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+import yaml
+
+from fleetx_tpu.utils.log import logger
+
+__all__ = ["export_inference_model", "load_exported", "default_forward_fn"]
+
+
+def default_forward_fn(module, input_spec):
+    """Forward closure matching the module's batch contract: passes
+    seq_lens when the spec carries it (classification pooling needs the
+    true lengths, not the padded end)."""
+    token_key = "tokens" if "tokens" in input_spec else "input_ids"
+    if "seq_lens" in input_spec:
+        def forward_fn(p, batch):
+            return module.nets.apply(
+                {"params": p}, batch[token_key], None, None, batch["seq_lens"]
+            )
+    else:
+        def forward_fn(p, batch):
+            return module.nets.apply({"params": p}, batch[token_key])
+    return forward_fn
+
+
+def _spec_to_json(spec_tree) -> Dict[str, Any]:
+    return {
+        k: {"shape": list(v.shape), "dtype": str(np.dtype(v.dtype))}
+        for k, v in (spec_tree or {}).items()
+    }
+
+
+def export_inference_model(
+    module,
+    params,
+    output_dir: str,
+    forward_fn=None,
+    input_spec: Optional[Dict[str, jax.ShapeDtypeStruct]] = None,
+) -> str:
+    """Write the export artifact for ``module`` with ``params``."""
+    import orbax.checkpoint as ocp
+
+    os.makedirs(output_dir, exist_ok=True)
+    input_spec = input_spec or module.input_spec()
+    if input_spec is None:
+        raise ValueError("module.input_spec() required for export")
+
+    # 1. config: everything needed to rebuild the module at load time
+    cfg = module.cfg
+    keep = {
+        k: dict(v) if hasattr(v, "keys") else v
+        for k, v in dict(cfg).items()
+        if k in ("Model", "Generation", "Global", "Data")
+    }
+    with open(os.path.join(output_dir, "config.yaml"), "w") as f:
+        yaml.safe_dump(json.loads(json.dumps(keep)), f)
+
+    # 2. params (unboxed; inference has no sharding metadata needs)
+    from fleetx_tpu.core.engine import _unbox
+
+    ckpter = ocp.StandardCheckpointer()
+    ckpter.save(
+        os.path.abspath(os.path.join(output_dir, "params")),
+        _unbox(params),
+        force=True,
+    )
+    ckpter.wait_until_finished()
+
+    # 3. StableHLO of the forward fn, traced at the exported shapes
+    if forward_fn is None:
+        forward_fn = default_forward_fn(module, input_spec)
+
+    abstract_params = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), _unbox(params)
+    )
+    abstract_batch = dict(input_spec)
+    lowered = jax.jit(forward_fn).lower(abstract_params, abstract_batch)
+    with open(os.path.join(output_dir, "forward.stablehlo"), "w") as f:
+        f.write(lowered.as_text())
+
+    with open(os.path.join(output_dir, "input_spec.json"), "w") as f:
+        json.dump(_spec_to_json(input_spec), f, indent=2)
+
+    logger.info("exported inference model to %s", output_dir)
+    return output_dir
+
+
+def load_exported(export_dir: str):
+    """(cfg_dict, params, input_spec) from an export artifact."""
+    import orbax.checkpoint as ocp
+
+    with open(os.path.join(export_dir, "config.yaml")) as f:
+        cfg = yaml.safe_load(f)
+    with open(os.path.join(export_dir, "input_spec.json")) as f:
+        spec = {
+            k: jax.ShapeDtypeStruct(tuple(v["shape"]), np.dtype(v["dtype"]))
+            for k, v in json.load(f).items()
+        }
+    ckpter = ocp.StandardCheckpointer()
+    params = ckpter.restore(os.path.abspath(os.path.join(export_dir, "params")))
+    return cfg, params, spec

@@ -1,0 +1,46 @@
+"""Interactive generation driver over an export artifact (reference
+/root/reference/tasks/gpt/generation.py:35-124: loads exported module,
+reads prompts from stdin, prints completions).
+
+    python tasks/gpt/generation.py --export-dir ./exported --vocab-dir ./vocab
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+from fleetx_tpu.core.inference_engine import InferenceEngine
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--export-dir", required=True)
+    ap.add_argument("--vocab-dir", default="./vocab")
+    ap.add_argument("--max-length", type=int, default=128)
+    args = ap.parse_args()
+
+    from fleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
+
+    tok = GPTTokenizer.from_pretrained(args.vocab_dir)
+    engine = InferenceEngine(args.export_dir)
+    print("prompt> ", end="", flush=True)
+    for line in sys.stdin:
+        prompt = line.strip()
+        if not prompt:
+            break
+        ids = np.asarray([tok.encode(prompt)], np.int32)
+        out = np.asarray(engine.generate(ids, max_length=args.max_length))
+        gen = out[0][ids.shape[1]:]
+        eos = np.nonzero(gen == engine.eos_token_id)[0]
+        if eos.size:  # trim the post-EOS pad fill
+            gen = gen[: eos[0]]
+        print(tok.decode(gen))
+        print("prompt> ", end="", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -115,7 +115,13 @@ def test_glue_tsv_parsing(tmp_path):
 
     m = tmp_path / "MNLI"
     m.mkdir()
-    row = "\t".join(str(i) for i in range(8)) + "\tpremise\thypothesis\tx\tentailment"
+    # real dev_matched layout: 16 cols, label1-5 at 10-14, gold_label at 15
+    row = (
+        "\t".join(str(i) for i in range(8))
+        + "\tpremise\thypothesis"
+        + "\tneutral" * 5  # annotator labels (must NOT be used)
+        + "\tentailment"  # gold_label
+    )
     (m / "dev_matched.tsv").write_text("h\n" + row + "\n")
     mn = GlueDataset("mnli", input_dir=str(m), vocab_dir=str(vocab_dir),
                      max_seq_len=16, mode="Eval")

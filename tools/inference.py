@@ -1,0 +1,58 @@
+"""Serve an exported model (reference /root/reference/tools/inference.py ->
+EagerEngine.inference -> InferenceEngine).
+
+    python tools/inference.py --export-dir ./exported --prompt "Hi there"
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+from fleetx_tpu.core.inference_engine import InferenceEngine
+from fleetx_tpu.utils.log import logger
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--export-dir", required=True)
+    ap.add_argument("--prompt", default=None, help="text (needs vocab) or "
+                    "comma-separated token ids")
+    ap.add_argument("--vocab-dir", default=None)
+    ap.add_argument("--max-length", type=int, default=None)
+    args = ap.parse_args()
+
+    engine = InferenceEngine(args.export_dir)
+    if args.prompt is None:
+        logger.info("no --prompt; running a smoke forward")
+        spec = engine.input_spec["tokens"]
+        logits = engine.predict({"tokens": np.zeros(spec.shape, spec.dtype)})
+        logger.info("forward OK, logits shape %s", logits.shape)
+        return
+
+    if all(p.strip().isdigit() for p in args.prompt.split(",")):
+        ids = np.asarray([[int(p) for p in args.prompt.split(",")]], np.int32)
+        tok = None
+    else:
+        from fleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
+
+        tok = GPTTokenizer.from_pretrained(args.vocab_dir or "./vocab")
+        ids = np.asarray([tok.encode(args.prompt)], np.int32)
+    kw = {}
+    if args.max_length:
+        kw["max_length"] = args.max_length
+    out = np.asarray(engine.generate(ids, **kw))
+    gen = out[0][ids.shape[1]:]
+    eos = np.nonzero(gen == engine.eos_token_id)[0]
+    if eos.size:  # trim the post-EOS pad fill
+        gen = gen[: eos[0] + 1]
+    logger.info("generated ids: %s", np.concatenate([ids[0], gen]).tolist())
+    if tok is not None:
+        logger.info("text: %s", tok.decode(np.concatenate([ids[0], gen])))
+
+
+if __name__ == "__main__":
+    main()
