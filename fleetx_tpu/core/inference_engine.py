@@ -49,7 +49,23 @@ class InferenceEngine:
             return self._forward
         from fleetx_tpu.utils.export import default_forward_fn
 
-        self._forward = jax.jit(default_forward_fn(self.module, self.input_spec))
+        fwd = default_forward_fn(self.module, self.input_spec)
+        if self.mesh is not None:
+            # replicated params + dp-sharded batch over the provided mesh;
+            # activation constraints inside the model resolve via the rules
+            from flax import linen as nn
+
+            from fleetx_tpu.parallel.sharding import make_rules
+
+            mesh, rules = self.mesh, make_rules()
+
+            def sharded(params, batch):
+                with mesh, nn.logical_axis_rules(rules):
+                    return jax.jit(fwd)(params, batch)
+
+            self._forward = sharded
+        else:
+            self._forward = jax.jit(fwd)
         return self._forward
 
     def predict(self, batch: Dict[str, np.ndarray]):

@@ -1,0 +1,5 @@
+from fleetx_tpu.models.protein.evoformer import (  # noqa: F401
+    EvoformerConfig,
+    EvoformerIteration,
+    EvoformerStack,
+)

@@ -1,0 +1,64 @@
+"""Dynamic axial parallelism (DAP) for Evoformer tensors.
+
+The reference implements FastFold-style DAP with hand-written PyLayer
+collectives over a dedicated process group
+(/root/reference/ppfleetx/distributed/protein_folding/dap.py:28-401:
+scatter/gather, all_gather, all_to_all, and the row↔col axis swaps
+row_to_col/col_to_row; scg.py:28-224 builds the groups).
+
+TPU-native: DAP is a *sharding layout*, not a set of collectives. MSA
+activations [B, S, R, C] shard the sequence axis (row ops) or the residue
+axis (col ops) over the ``cp`` mesh axis — the same axial-parallel mesh
+axis ring attention uses, since a model is either a language model or a
+folding trunk, never both in one step. ``row_to_col``/``col_to_row``
+become a change of sharding constraint; GSPMD inserts exactly the
+all_to_all the reference wrote by hand (dap.py:244-343), and overlaps it
+with compute.
+"""
+
+from __future__ import annotations
+
+import jax
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["row_sharded", "col_sharded", "pair_row_sharded", "pair_col_sharded"]
+
+# Logical axis names resolved by make_rules' 'act_seq' machinery would tie
+# us to the LM layout; the folding trunk declares its own:
+#   dap_row  -> cp   (MSA sequence axis / pair first-residue axis)
+#   dap_col  -> cp   (residue axis when column ops run)
+# Only one of the two is applied to a given tensor at a time.
+DAP_RULES = (("dap_axis", "cp"), ("dap_free", None), ("dap_batch", ("dp", "fsdp")))
+
+
+def _constrain(x, spec):
+    return nn.with_logical_constraint(x, P(*spec))
+
+
+def row_sharded(msa):
+    """[B, S, R, C] with the MSA-sequence axis S sharded: layout for row
+    attention (each device holds whole rows -> reference dap.scatter(axis=1))."""
+    return _constrain(msa, ("dap_batch", "dap_axis", "dap_free", None))
+
+
+def col_sharded(msa):
+    """[B, S, R, C] with the residue axis R sharded: layout for column
+    attention. row_sharded -> col_sharded IS the reference's row_to_col
+    all_to_all (dap.py:358-399), inserted by GSPMD."""
+    return _constrain(msa, ("dap_batch", "dap_free", "dap_axis", None))
+
+
+def pair_row_sharded(pair):
+    """[B, R, R, C] pair tensor sharded over the first residue axis."""
+    return _constrain(pair, ("dap_batch", "dap_axis", "dap_free", None))
+
+
+def pair_col_sharded(pair):
+    """[B, R, R, C] pair tensor sharded over the second residue axis."""
+    return _constrain(pair, ("dap_batch", "dap_free", "dap_axis", None))
+
+
+def dap_rules():
+    """Logical-axis rules to install alongside the standard make_rules set."""
+    return list(DAP_RULES)

@@ -74,7 +74,9 @@ def export_inference_model(
     keep = {
         k: dict(v) if hasattr(v, "keys") else v
         for k, v in dict(cfg).items()
-        if k in ("Model", "Generation", "Global", "Data")
+        # Engine carries mix_precision: without it the module would rebuild
+        # at inference in fp32 while the export traced bf16
+        if k in ("Model", "Generation", "Global", "Data", "Engine")
     }
     with open(os.path.join(output_dir, "config.yaml"), "w") as f:
         yaml.safe_dump(json.loads(json.dumps(keep)), f)
@@ -97,13 +99,17 @@ def export_inference_model(
     abstract_params = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), _unbox(params)
     )
-    abstract_batch = dict(input_spec)
-    lowered = jax.jit(forward_fn).lower(abstract_params, abstract_batch)
+    # prune the serving contract to the inputs the forward actually reads
+    # (a finetune module's training spec also lists labels)
+    token_key = "tokens" if "tokens" in input_spec else "input_ids"
+    served = [token_key] + (["seq_lens"] if "seq_lens" in input_spec else [])
+    serve_spec = {k: input_spec[k] for k in served}
+    lowered = jax.jit(forward_fn).lower(abstract_params, serve_spec)
     with open(os.path.join(output_dir, "forward.stablehlo"), "w") as f:
         f.write(lowered.as_text())
 
     with open(os.path.join(output_dir, "input_spec.json"), "w") as f:
-        json.dump(_spec_to_json(input_spec), f, indent=2)
+        json.dump(_spec_to_json(serve_spec), f, indent=2)
 
     logger.info("exported inference model to %s", output_dir)
     return output_dir

@@ -28,8 +28,10 @@ def main():
     engine = InferenceEngine(args.export_dir)
     if args.prompt is None:
         logger.info("no --prompt; running a smoke forward")
-        spec = engine.input_spec["tokens"]
-        logits = engine.predict({"tokens": np.zeros(spec.shape, spec.dtype)})
+        feed = {
+            k: np.zeros(v.shape, v.dtype) for k, v in engine.input_spec.items()
+        }
+        logits = engine.predict(feed)
         logger.info("forward OK, logits shape %s", logits.shape)
         return
 
@@ -47,8 +49,8 @@ def main():
     out = np.asarray(engine.generate(ids, **kw))
     gen = out[0][ids.shape[1]:]
     eos = np.nonzero(gen == engine.eos_token_id)[0]
-    if eos.size:  # trim the post-EOS pad fill
-        gen = gen[: eos[0] + 1]
+    if eos.size:  # trim EOS + the post-EOS pad fill (matches tasks/gpt driver)
+        gen = gen[: eos[0]]
     logger.info("generated ids: %s", np.concatenate([ids[0], gen]).tolist())
     if tok is not None:
         logger.info("text: %s", tok.decode(np.concatenate([ids[0], gen])))
