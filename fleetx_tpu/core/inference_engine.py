@@ -47,9 +47,14 @@ class InferenceEngine:
     def _compile(self):
         if self._forward is not None:
             return self._forward
-        from fleetx_tpu.utils.export import default_forward_fn
+        from fleetx_tpu.utils.export import serving_contract
 
-        fwd = default_forward_fn(self.module, self.input_spec)
+        fwd, _ = serving_contract(self.module, self.input_spec)
+        if fwd is None:
+            raise ValueError(
+                "export has no default serving contract; use the module API "
+                "directly (predict() supports token-contract exports only)"
+            )
         if self.mesh is not None:
             # replicated params + dp-sharded batch over the provided mesh;
             # activation constraints inside the model resolve via the rules
@@ -72,8 +77,8 @@ class InferenceEngine:
         """Raw forward logits for a token batch (pass seq_lens for padded
         classification batches — the export's input_spec says if needed)."""
         fn = self._compile()
-        token_key = "tokens" if "tokens" in self.input_spec else "input_ids"
-        required = [token_key] + (["seq_lens"] if "seq_lens" in self.input_spec else [])
+        # the export's input_spec holds exactly the served keys
+        required = list(self.input_spec)
         missing = [k for k in required if k not in batch]
         if missing:
             raise ValueError(f"batch missing {missing} (export input_spec)")

@@ -33,8 +33,10 @@ def _dataset_registry():
     _DATASETS.setdefault("SyntheticClsDataset", SyntheticClsDataset)
     _DATASETS.setdefault("ContrastiveViewsDataset", ContrastiveViewsDataset)
     from fleetx_tpu.data.glue_dataset import GlueDataset
+    from fleetx_tpu.data.multimodal_dataset import TextImageDataset
 
     _DATASETS.setdefault("GlueDataset", GlueDataset)
+    _DATASETS.setdefault("TextImageDataset", TextImageDataset)
     _DATASETS.setdefault("ErnieDataset", ErnieDataset)
     _DATASETS.setdefault("GPTDataset", GPTDataset)
     _DATASETS.setdefault("LM_Eval_Dataset", LMEvalDataset)

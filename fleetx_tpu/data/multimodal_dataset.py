@@ -1,0 +1,65 @@
+"""Text-image pair datasets for Imagen (reference
+/root/reference/ppfleetx/data/dataset/multimodal_dataset.py, 180 LoC).
+
+Storage: ``{prefix}_images.npy`` [N,H,W,3] uint8 (mmap),
+``{prefix}_embeds.npy`` [N,L,D] float16/32 (mmap, precomputed T5/encoder
+embeddings), ``{prefix}_mask.npy`` [N,L]. ``synthetic: True`` generates
+noise pairs for benchmarking."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from fleetx_tpu.utils.log import logger
+
+__all__ = ["TextImageDataset"]
+
+
+class TextImageDataset:
+    def __init__(self, input_dir=None, image_size: int = 64, mode="Train",
+                 seed: int = 1234, num_samples: Optional[int] = None,
+                 synthetic: bool = False, max_text_len: int = 64,
+                 cond_dim: int = 512, **_unused):
+        self.image_size = image_size
+        self.seed = seed
+        self.max_text_len = max_text_len
+        self.cond_dim = cond_dim
+        self.synthetic = synthetic or input_dir is None
+        if self.synthetic:
+            self._num_samples = num_samples or 1280
+            self.images = self.embeds = self.mask = None
+            return
+        prefix = input_dir
+        if os.path.isdir(prefix):
+            prefix = os.path.join(prefix, mode.lower())
+        self.images = np.load(prefix + "_images.npy", mmap_mode="r")
+        self.embeds = np.load(prefix + "_embeds.npy", mmap_mode="r")
+        self.mask = np.load(prefix + "_mask.npy", mmap_mode="r")
+        self._num_samples = num_samples or len(self.images)
+        logger.info("TextImageDataset[%s]: %d pairs", mode, self._num_samples)
+
+    def __len__(self):
+        return self._num_samples
+
+    def __getitem__(self, index):
+        if self.synthetic:
+            rng = np.random.RandomState((self.seed + index) % (2**31))
+            s = self.image_size
+            return {
+                "images": rng.uniform(-1, 1, (s, s, 3)).astype(np.float32),
+                "text_embeds": rng.randn(self.max_text_len, self.cond_dim)
+                .astype(np.float32),
+                "text_mask": (np.arange(self.max_text_len)
+                              < rng.randint(4, self.max_text_len))
+                .astype(np.float32),
+            }
+        i = index % len(self.images)
+        img = np.asarray(self.images[i]).astype(np.float32) / 127.5 - 1.0
+        return {
+            "images": img,
+            "text_embeds": np.asarray(self.embeds[i], np.float32),
+            "text_mask": np.asarray(self.mask[i], np.float32),
+        }

@@ -1,0 +1,119 @@
+"""ImagenModule — text-to-image diffusion pretraining (reference
+/root/reference/ppfleetx/models/multimodal_model/multimodal_module.py +
+imagen/modeling.py ImagenModel.forward: pick a cascade stage, q_sample,
+predict noise, p2-weighted MSE).
+
+Batch contract (text embeddings are PRECOMPUTED, see unet.py docstring):
+  images       [b, H, W, 3] float32 in [-1, 1]
+  text_embeds  [b, L, D] float32
+  text_mask    [b, L] float32/int
+For SR stages (unet_number > 1) the low-res conditioning image is derived
+in-graph by area-downsampling the target (reference resize_image_to)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from fleetx_tpu.models.language_module import resolve_compute_dtype
+from fleetx_tpu.models.module import BasicModule
+from fleetx_tpu.models.multimodal.imagen import imagen_criterion, q_sample
+from fleetx_tpu.models.multimodal.unet import (
+    UNET_PRESETS,
+    UNetConfig,
+    EfficientUNet,
+    build_unet,
+)
+from fleetx_tpu.models.vision_module import log_images_per_sec
+
+__all__ = ["ImagenModule"]
+
+
+class ImagenModule(BasicModule):
+    def get_model(self):
+        model_cfg = self.cfg.Model if hasattr(self.cfg, "Model") else self.cfg
+        eng = getattr(self.cfg, "Engine", None) or {}
+        dtype = resolve_compute_dtype(eng)
+        name = model_cfg.get("unet_name")
+        self.image_size = int(model_cfg.get("image_size") or 64)
+        self.lowres_size = model_cfg.get("lowres_size")  # set for SR stages
+        self.p2_gamma = float(model_cfg.get("p2_loss_weight_gamma") or 0.0)
+        self.p2_k = float(model_cfg.get("p2_loss_weight_k") or 1.0)
+        overrides = {"dtype": dtype}
+        if model_cfg.get("cond_dim"):
+            overrides["cond_dim"] = int(model_cfg["cond_dim"])
+        if name:
+            model = build_unet(name, **overrides)
+        else:
+            model = EfficientUNet(UNetConfig.from_model_config(
+                {**dict(model_cfg), **overrides}
+            ))
+        self.unet_config = model.cfg
+        return model
+
+    def _lowres(self, images):
+        if not self.unet_config.lowres_cond:
+            return None
+        size = int(self.lowres_size or self.image_size // 4)
+        b, h, w, ch = images.shape
+        low = jax.image.resize(images, (b, size, size, ch), method="linear")
+        return jax.image.resize(low, (b, h, w, ch), method="nearest")
+
+    def init_params(self, rng, batch):
+        images = jnp.asarray(batch["images"])
+        t = jnp.zeros((images.shape[0],), jnp.float32)
+        return self.nets.init(
+            rng, images, t, jnp.asarray(batch["text_embeds"]),
+            jnp.asarray(batch["text_mask"]), self._lowres(images),
+        )
+
+    def loss_fn(self, params, batch, rng, train: bool):
+        images = batch["images"]
+        b = images.shape[0]
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        t_rng, n_rng = jax.random.split(rng)
+        t = jax.random.uniform(t_rng, (b,))
+        noise = jax.random.normal(n_rng, images.shape, jnp.float32)
+        x_t, log_snr = q_sample(images, t, noise)
+        pred = self.nets.apply(
+            {"params": params}, x_t, t, batch.get("text_embeds"),
+            batch.get("text_mask"), self._lowres(images),
+        )
+        loss = imagen_criterion(pred, noise, log_snr, self.p2_gamma, self.p2_k)
+        return loss, {}
+
+    def input_spec(self):
+        glb = self.cfg.Global
+        model_cfg = self.cfg.Model
+        b = glb.micro_batch_size or 1
+        s = self.image_size
+        L = int(model_cfg.get("max_text_len") or 64)
+        D = int(self.unet_config.cond_dim)
+        return {
+            "images": jax.ShapeDtypeStruct((b, s, s, 3), jnp.float32),
+            "text_embeds": jax.ShapeDtypeStruct((b, L, D), jnp.float32),
+            "text_mask": jax.ShapeDtypeStruct((b, L), jnp.float32),
+        }
+
+    def serving_forward(self, input_spec):
+        """Serve one UNet denoising step eps(x_t, t, text); samplers drive
+        it in a loop (ddpm_sample)."""
+        spec = {k: input_spec[k] for k in ("images", "text_embeds", "text_mask")}
+        b = spec["images"].shape[0]
+        spec["t"] = jax.ShapeDtypeStruct((b,), jnp.float32)
+
+        def fn(p, feed):
+            images = feed["images"]
+            low = self._lowres(images) if self.unet_config.lowres_cond else None
+            return self.nets.apply(
+                {"params": p}, images, feed["t"], feed.get("text_embeds"),
+                feed.get("text_mask"), low,
+            )
+
+        return fn, spec
+
+    def training_step_end(self, log: Dict) -> None:
+        log_images_per_sec(self.cfg, log)

@@ -1,0 +1,11 @@
+from fleetx_tpu.models.multimodal.unet import (  # noqa: F401
+    EfficientUNet,
+    UNetConfig,
+    UNET_PRESETS,
+    build_unet,
+)
+from fleetx_tpu.models.multimodal.imagen import (  # noqa: F401
+    cosine_log_snr,
+    imagen_criterion,
+    q_sample,
+)

@@ -1,0 +1,283 @@
+"""Efficient-UNet for Imagen, TPU-native flax implementation.
+
+Capability parity with the reference's UNet zoo
+(/root/reference/ppfleetx/models/multimodal_model/imagen/unet.py, 1,485 LoC,
+and modeling.py:32-87 presets Unet64_397M / BaseUnet64 / SRUnet256 /
+SRUnet1024): time-conditioned ResNet blocks with scale-shift, per-resolution
+self-attention + text cross-attention transformer blocks, skip connections,
+efficient (downsample-first) variant, low-res conditioning channel for the
+SR cascade stages.
+
+TPU-first: channels-last [B, H, W, C] conv layout, GroupNorm (no running
+stats), attention over flattened spatial tokens hits the shared fused path.
+Text conditioning consumes *precomputed* encoder embeddings [B, L, D] (the
+reference embeds T5/DeBERTa in-process, utils.py:431 — precomputing is the
+standard TPU data-hall recipe and keeps the train step text-model-free).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+__all__ = ["UNetConfig", "EfficientUNet", "UNET_PRESETS", "build_unet"]
+
+
+@dataclasses.dataclass(frozen=True)
+class UNetConfig:
+    dim: int = 128
+    dim_mults: Tuple[int, ...] = (1, 2, 3, 4)
+    num_resnet_blocks: Union[int, Tuple[int, ...]] = 2
+    layer_attns: Union[bool, Tuple[bool, ...]] = (False, True, True, True)
+    layer_cross_attns: Union[bool, Tuple[bool, ...]] = (False, True, True, True)
+    attn_heads: int = 8
+    ff_mult: float = 2.0
+    channels: int = 3
+    cond_dim: int = 512  # text embedding dim
+    lowres_cond: bool = False  # SR stages concat the upsampled low-res image
+    memory_efficient: bool = False  # downsample before the resnet stack
+    groups: int = 8
+    dtype: Dtype = jnp.bfloat16
+
+    def per_layer(self, v, i):
+        if isinstance(v, (tuple, list)):
+            return v[i]
+        return v
+
+    @classmethod
+    def from_model_config(cls, model_cfg) -> "UNetConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in dict(model_cfg).items() if k in known and v is not None}
+        for key in ("dim_mults", "num_resnet_blocks", "layer_attns", "layer_cross_attns"):
+            if isinstance(kw.get(key), list):
+                kw[key] = tuple(kw[key])
+        if isinstance(kw.get("dtype"), str):
+            kw["dtype"] = jnp.dtype(kw["dtype"]).type
+        return cls(**kw)
+
+
+# reference modeling.py:32-87
+UNET_PRESETS = {
+    "Unet64_397M": dict(dim=256, dim_mults=(1, 2, 3, 4), num_resnet_blocks=3,
+                        layer_attns=(False, True, True, True),
+                        layer_cross_attns=(False, True, True, True),
+                        attn_heads=8, ff_mult=2.0, memory_efficient=False),
+    "BaseUnet64": dict(dim=512, dim_mults=(1, 2, 3, 4), num_resnet_blocks=3,
+                       layer_attns=(False, True, True, True),
+                       layer_cross_attns=(False, True, True, True),
+                       attn_heads=8, ff_mult=2.0, memory_efficient=False),
+    "SRUnet256": dict(dim=128, dim_mults=(1, 2, 4, 8),
+                      num_resnet_blocks=(2, 4, 8, 8),
+                      layer_attns=(False, False, False, True),
+                      layer_cross_attns=(False, False, False, True),
+                      attn_heads=8, ff_mult=2.0, memory_efficient=True,
+                      lowres_cond=True),
+    "SRUnet1024": dict(dim=128, dim_mults=(1, 2, 4, 8),
+                       num_resnet_blocks=(2, 4, 8, 8),
+                       layer_attns=False,
+                       layer_cross_attns=(False, False, False, True),
+                       attn_heads=8, ff_mult=2.0, memory_efficient=True,
+                       lowres_cond=True),
+}
+
+
+def build_unet(name: str, **overrides) -> "EfficientUNet":
+    if name not in UNET_PRESETS:
+        raise ValueError(f"unknown unet {name!r}; have {sorted(UNET_PRESETS)}")
+    return EfficientUNet(UNetConfig(**{**UNET_PRESETS[name], **overrides}))
+
+
+def _timestep_embedding(t, dim):
+    """Sinusoidal embedding of continuous t in [0, 1]."""
+    half = dim // 2
+    freqs = jnp.exp(-jnp.log(10000.0) * jnp.arange(half) / max(half - 1, 1))
+    args = t[:, None] * freqs[None, :] * 1000.0
+    return jnp.concatenate([jnp.sin(args), jnp.cos(args)], axis=-1)
+
+
+def _conv(features, kernel, name, dtype, strides=1):
+    return nn.Conv(features, (kernel, kernel), (strides, strides),
+                   padding="SAME", dtype=dtype, param_dtype=jnp.float32,
+                   name=name)
+
+
+class ResnetBlock(nn.Module):
+    """GroupNorm-SiLU-conv x2 with time scale-shift conditioning
+    (reference unet.py ResnetBlock)."""
+
+    cfg: UNetConfig
+    features: int
+
+    @nn.compact
+    def __call__(self, x, time_emb):
+        c = self.cfg
+        gn = lambda n, f: nn.GroupNorm(num_groups=min(c.groups, f),
+                                       dtype=c.dtype, param_dtype=jnp.float32,
+                                       name=n)
+        h = gn("gn1", x.shape[-1])(x)
+        h = nn.silu(h)
+        h = _conv(self.features, 3, "conv1", c.dtype)(h)
+        # time conditioning -> per-channel scale & shift
+        ss = nn.Dense(2 * self.features, dtype=c.dtype, param_dtype=jnp.float32,
+                      name="time_proj")(nn.silu(time_emb))
+        scale, shift = jnp.split(ss[:, None, None, :], 2, axis=-1)
+        h = gn("gn2", self.features)(h) * (1.0 + scale) + shift
+        h = nn.silu(h)
+        h = _conv(self.features, 3, "conv2", c.dtype)(h)
+        if x.shape[-1] != self.features:
+            x = _conv(self.features, 1, "skip", c.dtype)(x)
+        return x + h
+
+
+class TransformerBlock(nn.Module):
+    """Self-attention (+ optional text cross-attention) + FF over flattened
+    spatial tokens (reference unet.py TransformerBlock/CrossAttention)."""
+
+    cfg: UNetConfig
+    cross: bool
+
+    @nn.compact
+    def __call__(self, x, text_embeds=None, text_mask=None):
+        c = self.cfg
+        b, h, w, ch = x.shape
+        nh = c.attn_heads
+        hd = max(ch // nh, 8)
+        tokens = x.reshape(b, h * w, ch)
+
+        def attn(q_in, kv_in, name, kv_mask=None):
+            q = nn.DenseGeneral((nh, hd), dtype=c.dtype, param_dtype=jnp.float32,
+                                name=f"{name}_q")(q_in)
+            k = nn.DenseGeneral((nh, hd), dtype=c.dtype, param_dtype=jnp.float32,
+                                name=f"{name}_k")(kv_in)
+            v = nn.DenseGeneral((nh, hd), dtype=c.dtype, param_dtype=jnp.float32,
+                                name=f"{name}_v")(kv_in)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                                preferred_element_type=jnp.float32)
+            logits = logits / jnp.sqrt(hd).astype(jnp.float32)
+            if kv_mask is not None:
+                logits = jnp.where(kv_mask[:, None, None, :].astype(bool),
+                                   logits, -1e9)
+            w_ = jax.nn.softmax(logits, axis=-1).astype(c.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", w_, v)
+            return nn.DenseGeneral(ch, axis=(-2, -1), dtype=c.dtype,
+                                   param_dtype=jnp.float32,
+                                   name=f"{name}_out")(out)
+
+        y = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32,
+                         name="self_norm")(tokens)
+        tokens = tokens + attn(y, y, "self_attn")
+        if self.cross and text_embeds is not None:
+            y = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32,
+                             name="cross_norm")(tokens)
+            t = text_embeds.astype(c.dtype)
+            tokens = tokens + attn(y, t, "cross_attn", kv_mask=text_mask)
+        y = nn.LayerNorm(dtype=c.dtype, param_dtype=jnp.float32,
+                         name="ff_norm")(tokens)
+        y = nn.Dense(int(ch * c.ff_mult), dtype=c.dtype, param_dtype=jnp.float32,
+                     name="ff1")(y)
+        y = nn.gelu(y)
+        tokens = tokens + nn.Dense(ch, dtype=c.dtype, param_dtype=jnp.float32,
+                                   name="ff2")(y)
+        return tokens.reshape(b, h, w, ch)
+
+
+class EfficientUNet(nn.Module):
+    """Cascading-DDPM UNet stage (reference unet.py Unet, :592-1480).
+
+    call(x_t [B,H,W,C], t [B], text_embeds [B,L,D], text_mask [B,L],
+    lowres_cond_img [B,H,W,C] for SR stages) -> predicted noise.
+    """
+
+    cfg: UNetConfig
+
+    @nn.compact
+    def __call__(self, x, t, text_embeds=None, text_mask=None,
+                 lowres_cond_img=None):
+        c = self.cfg
+        x = x.astype(c.dtype)
+        if c.lowres_cond:
+            if lowres_cond_img is None:
+                raise ValueError("SR unet needs lowres_cond_img")
+            x = jnp.concatenate([x, lowres_cond_img.astype(c.dtype)], axis=-1)
+
+        time_dim = c.dim * 4
+        temb = _timestep_embedding(t, c.dim)
+        temb = nn.Dense(time_dim, param_dtype=jnp.float32, name="time_mlp1")(temb)
+        temb = nn.silu(temb)
+        temb = nn.Dense(time_dim, param_dtype=jnp.float32, name="time_mlp2")(temb)
+        if text_embeds is not None:
+            # pooled text -> added to time conditioning (reference unet.py
+            # to_text_non_attn_cond)
+            mask = (text_mask if text_mask is not None
+                    else jnp.ones(text_embeds.shape[:2]))[..., None]
+            pooled = (text_embeds * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0)
+            temb = temb + nn.Dense(time_dim, param_dtype=jnp.float32,
+                                   name="text_pool_proj")(pooled.astype(jnp.float32))
+        temb = temb.astype(c.dtype)
+
+        x = _conv(c.dim, 3, "init_conv", c.dtype)(x)
+        hs = []
+        dims = [c.dim * m for m in c.dim_mults]
+        n_stages = len(dims)
+
+        for i, d in enumerate(dims):
+            blocks = c.per_layer(c.num_resnet_blocks, i)
+            if c.memory_efficient and i > 0:
+                x = _conv(d, 3, f"down_{i}_pre", c.dtype, strides=2)(x)
+            for j in range(blocks):
+                x = ResnetBlock(c, d, name=f"down_{i}_res{j}")(x, temb)
+                hs.append(x)
+            if c.per_layer(c.layer_attns, i):
+                x = TransformerBlock(
+                    c, cross=bool(c.per_layer(c.layer_cross_attns, i)),
+                    name=f"down_{i}_attn",
+                )(x, text_embeds, text_mask)
+                hs.append(x)
+            if not c.memory_efficient and i < n_stages - 1:
+                x = _conv(d, 3, f"down_{i}_post", c.dtype, strides=2)(x)
+
+        x = ResnetBlock(c, dims[-1], name="mid_res1")(x, temb)
+        x = TransformerBlock(
+            c, cross=bool(c.per_layer(c.layer_cross_attns, n_stages - 1)),
+            name="mid_attn",
+        )(x, text_embeds, text_mask)
+        x = ResnetBlock(c, dims[-1], name="mid_res2")(x, temb)
+
+        for i in reversed(range(n_stages)):
+            d = dims[i]
+            blocks = c.per_layer(c.num_resnet_blocks, i)
+            n_skips = blocks + (1 if c.per_layer(c.layer_attns, i) else 0)
+            for j in range(n_skips):
+                skip = hs.pop()
+                if skip.shape[1] != x.shape[1]:
+                    x = jax.image.resize(
+                        x, (x.shape[0], skip.shape[1], skip.shape[2], x.shape[3]),
+                        method="nearest",
+                    )
+                x = jnp.concatenate([x, skip], axis=-1)
+                x = ResnetBlock(c, d, name=f"up_{i}_res{j}")(x, temb)
+            if c.per_layer(c.layer_attns, i):
+                x = TransformerBlock(
+                    c, cross=bool(c.per_layer(c.layer_cross_attns, i)),
+                    name=f"up_{i}_attn",
+                )(x, text_embeds, text_mask)
+            if i > 0:
+                target = x.shape[1] * 2
+                x = jax.image.resize(
+                    x, (x.shape[0], target, target, x.shape[3]), method="nearest"
+                )
+                x = _conv(dims[i - 1], 3, f"up_{i}_conv", c.dtype)(x)
+
+        x = _conv(c.dim, 3, "final_res", c.dtype)(x)
+        x = nn.silu(x)
+        out = nn.Conv(c.channels, (3, 3), padding="SAME", dtype=jnp.float32,
+                      param_dtype=jnp.float32,
+                      kernel_init=nn.initializers.zeros_init(),
+                      name="final_conv")(x.astype(jnp.float32))
+        return out

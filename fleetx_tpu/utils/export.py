@@ -28,23 +28,36 @@ import yaml
 
 from fleetx_tpu.utils.log import logger
 
-__all__ = ["export_inference_model", "load_exported", "default_forward_fn"]
+__all__ = ["export_inference_model", "load_exported", "serving_contract"]
 
 
-def default_forward_fn(module, input_spec):
-    """Forward closure matching the module's batch contract: passes
-    seq_lens when the spec carries it (classification pooling needs the
-    true lengths, not the padded end)."""
-    token_key = "tokens" if "tokens" in input_spec else "input_ids"
+def serving_contract(module, input_spec):
+    """(forward_fn(params, feed), served_keys) — THE single place the
+    serving batch contract is derived; export pruning and
+    InferenceEngine.predict both consume it.
+
+    Resolution order: a module-provided ``serving_forward(input_spec)``
+    hook, then the language-model token contract (tokens/input_ids +
+    optional seq_lens for classification pooling). Anything else must
+    export with an explicit ``forward_fn`` (served keys = whole spec).
+    """
+    hook = getattr(module, "serving_forward", None)
+    if hook is not None:
+        return hook(input_spec)
+    token_key = next((k for k in ("tokens", "input_ids") if k in input_spec), None)
+    if token_key is None:
+        return None, None
     if "seq_lens" in input_spec:
         def forward_fn(p, batch):
             return module.nets.apply(
                 {"params": p}, batch[token_key], None, None, batch["seq_lens"]
             )
-    else:
-        def forward_fn(p, batch):
-            return module.nets.apply({"params": p}, batch[token_key])
-    return forward_fn
+        return forward_fn, [token_key, "seq_lens"]
+
+    def forward_fn(p, batch):
+        return module.nets.apply({"params": p}, batch[token_key])
+
+    return forward_fn, [token_key]
 
 
 def _spec_to_json(spec_tree) -> Dict[str, Any]:
@@ -94,16 +107,26 @@ def export_inference_model(
 
     # 3. StableHLO of the forward fn, traced at the exported shapes
     if forward_fn is None:
-        forward_fn = default_forward_fn(module, input_spec)
+        forward_fn, served = serving_contract(module, input_spec)
+        if forward_fn is None:
+            raise ValueError(
+                f"{type(module).__name__} has no default serving contract "
+                "(batch carries none of tokens/input_ids and the module "
+                "defines no serving_forward) — pass forward_fn= explicitly"
+            )
+    else:
+        served = list(input_spec)  # caller-supplied forward: serve the full spec
 
     abstract_params = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), _unbox(params)
     )
-    # prune the serving contract to the inputs the forward actually reads
-    # (a finetune module's training spec also lists labels)
-    token_key = "tokens" if "tokens" in input_spec else "input_ids"
-    served = [token_key] + (["seq_lens"] if "seq_lens" in input_spec else [])
-    serve_spec = {k: input_spec[k] for k in served}
+    # input_spec.json records exactly the served keys (a finetune module's
+    # training spec also lists labels, which serving never reads). A
+    # serving_forward hook may return a full spec dict with extra inputs
+    # (e.g. the diffusion timestep).
+    serve_spec = served if isinstance(served, dict) else {
+        k: input_spec[k] for k in served
+    }
     lowered = jax.jit(forward_fn).lower(abstract_params, serve_spec)
     with open(os.path.join(output_dir, "forward.stablehlo"), "w") as f:
         f.write(lowered.as_text())

@@ -1,0 +1,200 @@
+"""Imagen tests: diffusion math identities, UNet shapes (base + SR presets
+on tiny dims), sampler, and an e2e ImagenModule training run."""
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fleetx_tpu.models.multimodal.imagen import (
+    cosine_log_snr,
+    ddpm_sample,
+    imagen_criterion,
+    log_snr_to_alpha_sigma,
+    q_sample,
+)
+from fleetx_tpu.models.multimodal.unet import (
+    UNET_PRESETS,
+    UNetConfig,
+    EfficientUNet,
+    build_unet,
+)
+
+TINY = UNetConfig(
+    dim=16, dim_mults=(1, 2), num_resnet_blocks=1,
+    layer_attns=(False, True), layer_cross_attns=(False, True),
+    attn_heads=2, cond_dim=12, dtype=jnp.float32,
+)
+
+
+def test_schedule_identities():
+    t = jnp.linspace(0.0, 1.0, 11)
+    log_snr = cosine_log_snr(t)
+    # monotone decreasing SNR
+    assert (np.diff(np.asarray(log_snr)) < 0).all()
+    alpha, sigma = log_snr_to_alpha_sigma(log_snr)
+    np.testing.assert_allclose(np.asarray(alpha**2 + sigma**2), 1.0, atol=1e-6)
+    # t=0 nearly clean, t=1 nearly pure noise
+    assert float(alpha[0]) > 0.99 and float(alpha[-1]) < 0.05
+
+
+def test_q_sample_and_criterion():
+    rng = np.random.default_rng(0)
+    x0 = jnp.asarray(rng.normal(size=(2, 8, 8, 3)), jnp.float32)
+    noise = jnp.asarray(rng.normal(size=x0.shape), jnp.float32)
+    x_t, log_snr = q_sample(x0, jnp.array([0.0, 1.0]), noise)
+    np.testing.assert_allclose(np.asarray(x_t[0]), np.asarray(x0[0]), atol=0.05)
+    np.testing.assert_allclose(np.asarray(x_t[1]), np.asarray(noise[1]), atol=0.05)
+    # perfect prediction -> zero loss; p2 weighting changes the value
+    assert float(imagen_criterion(noise, noise, log_snr)) == 0.0
+    l0 = imagen_criterion(x_t, noise, log_snr, 0.0)
+    l1 = imagen_criterion(x_t, noise, log_snr, 1.0)
+    assert float(l0) != float(l1)
+
+
+def test_unet_shapes_and_presets():
+    assert set(UNET_PRESETS) == {"Unet64_397M", "BaseUnet64", "SRUnet256",
+                                 "SRUnet1024"}
+    model = EfficientUNet(TINY)
+    x = jnp.zeros((2, 16, 16, 3))
+    t = jnp.zeros((2,))
+    emb = jnp.zeros((2, 6, 12))
+    mask = jnp.ones((2, 6))
+    vars_ = model.init(jax.random.PRNGKey(0), x, t, emb, mask)
+    out = model.apply(vars_, x, t, emb, mask)
+    assert out.shape == x.shape
+    with pytest.raises(ValueError):
+        build_unet("NoSuchUnet")
+
+
+def test_sr_unet_lowres_conditioning():
+    cfg = UNetConfig(**{**TINY.__dict__, "lowres_cond": True,
+                        "memory_efficient": True})
+    model = EfficientUNet(cfg)
+    x = jnp.zeros((1, 16, 16, 3))
+    t = jnp.zeros((1,))
+    low = jnp.zeros((1, 16, 16, 3))
+    vars_ = model.init(jax.random.PRNGKey(0), x, t, None, None, low)
+    out = model.apply(vars_, x, t, None, None, low)
+    assert out.shape == x.shape
+    with pytest.raises(ValueError):
+        model.apply(vars_, x, t, None, None, None)
+
+
+def test_ddpm_sampler_shapes():
+    model = EfficientUNet(TINY)
+    x = jnp.zeros((1, 16, 16, 3))
+    emb = jnp.zeros((1, 6, 12))
+    mask = jnp.ones((1, 6))
+    vars_ = model.init(jax.random.PRNGKey(0), x, jnp.zeros((1,)), emb, mask)
+
+    def apply(p, x, t, e, m, low):
+        return model.apply(p, x, t, e, m, low)
+
+    out = ddpm_sample(apply, vars_, (1, 16, 16, 3), jax.random.PRNGKey(1),
+                      steps=3, text_embeds=emb, text_mask=mask)
+    assert out.shape == (1, 16, 16, 3)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_imagen_export_serving_contract(tmp_path):
+    """Non-LM export: ImagenModule's serving_forward hook must carry the
+    extra timestep input through the artifact."""
+    from fleetx_tpu.models import build_module
+    from fleetx_tpu.utils.config import AttrDict, process_configs
+    from fleetx_tpu.utils.export import export_inference_model, load_exported
+
+    cfg = AttrDict(
+        Global=AttrDict(seed=0, local_batch_size=2, micro_batch_size=2),
+        Engine=AttrDict(mix_precision=AttrDict(use_pure_fp16=False)),
+        Model=AttrDict(module="ImagenModule", dim=16, dim_mults=[1, 2],
+                       num_resnet_blocks=1, layer_attns=[False, True],
+                       layer_cross_attns=[False, True], attn_heads=2,
+                       cond_dim=12, image_size=16, max_text_len=6),
+        Optimizer=AttrDict(name="AdamW", lr=AttrDict(
+            name="CosineDecay", learning_rate=1e-4, decay_steps=10)),
+        Distributed=AttrDict(dp_degree=1),
+    )
+    process_configs(cfg, nranks=1)
+    module = build_module(cfg)
+    spec = module.input_spec()
+    params = module.init_params(
+        jax.random.PRNGKey(0),
+        {k: np.zeros(v.shape, v.dtype) for k, v in spec.items()},
+    )["params"]
+    out = str(tmp_path / "imagen_export")
+    export_inference_model(module, params, out, input_spec=spec)
+    _, _, loaded_spec = load_exported(out)
+    assert "t" in loaded_spec and "images" in loaded_spec
+    assert "labels" not in loaded_spec
+
+
+def test_imagen_module_end_to_end(tmp_path, eight_devices):
+    from fleetx_tpu.core.engine import Trainer
+    from fleetx_tpu.data import build_dataloader
+    from fleetx_tpu.models import build_module
+    from fleetx_tpu.utils.config import get_config
+
+    text = textwrap.dedent(
+        """
+        Global:
+          seed: 7
+          local_batch_size: 4
+          micro_batch_size: 4
+        Engine:
+          max_steps: 3
+          logging_freq: 1
+          eval_freq: 0
+          save_load:
+            save_steps: 1000
+        Model:
+          module: ImagenModule
+          dim: 16
+          dim_mults: [1, 2]
+          num_resnet_blocks: 1
+          layer_attns: [False, True]
+          layer_cross_attns: [False, True]
+          attn_heads: 2
+          cond_dim: 12
+          image_size: 16
+          max_text_len: 6
+        Optimizer:
+          name: AdamW
+          weight_decay: 0.0
+          lr:
+            name: LinearDecayWithWarmup
+            warmup: 2
+            total_steps: 100
+            max_lr: 1.0e-4
+          grad_clip:
+            name: ClipGradByGlobalNorm
+            clip_norm: 1.0
+        Data:
+          Train:
+            dataset:
+              name: TextImageDataset
+              synthetic: True
+              image_size: 16
+              max_text_len: 6
+              cond_dim: 12
+              num_samples: 64
+            sampler:
+              name: GPTBatchSampler
+              shuffle: True
+            loader:
+              num_workers: 0
+        Distributed:
+          dp_degree: 2
+        """
+    )
+    p = tmp_path / "imagen.yaml"
+    p.write_text(text)
+    cfg = get_config(str(p), nranks=2)
+    cfg.Engine.save_load.output_dir = str(tmp_path / "out")
+    module = build_module(cfg)
+    trainer = Trainer(cfg, module)
+    loader = build_dataloader(cfg, "Train")
+    trainer.fit(loader)
+    assert int(trainer.state.step) == 3
