@@ -1,0 +1,97 @@
+"""Quantization ops: int8 weight-only PTQ for serving + fake-quant QAT.
+
+The reference's quantization story is paddleslim QAT configs
+(qat_gpt_*.yaml; utils/export.py quant-aware export path). TPU-native
+equivalents:
+
+- **PTQ (serving)**: per-channel absmax int8 of dense kernels — halves (vs
+  bf16) or quarters (vs fp32) the HBM a served model needs; matmuls
+  dequantize on the fly (XLA fuses the scale multiply into the consumer).
+- **QAT (training)**: straight-through-estimator fake quantization applied
+  to weights inside the jitted loss; gradients flow as identity
+  (lax.stop_gradient trick), matching paddleslim's weight-quant QAT
+  semantics without graph surgery.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "quantize_int8",
+    "dequantize_int8",
+    "fake_quant",
+    "quantize_tree_int8",
+    "dequantize_tree_int8",
+    "fake_quant_tree",
+]
+
+
+def quantize_int8(w: jax.Array, axis: int = -1):
+    """(int8 values, fp32 scales) with per-channel absmax along ``axis``
+    kept; scale shape broadcasts back against w."""
+    w = w.astype(jnp.float32)
+    reduce_axes = tuple(i for i in range(w.ndim) if i != (axis % w.ndim))
+    absmax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = absmax / 127.0
+    q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-12)), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_int8(q: jax.Array, scale: jax.Array, dtype=jnp.float32):
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def fake_quant(w: jax.Array, bits: int = 8, axis: int = -1):
+    """Quantize-dequantize with a straight-through gradient."""
+    maxq = 2 ** (bits - 1) - 1
+    w32 = w.astype(jnp.float32)
+    reduce_axes = tuple(i for i in range(w32.ndim) if i != (axis % w32.ndim))
+    absmax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(absmax / maxq, 1e-12)
+    deq = jnp.clip(jnp.round(w32 / scale), -maxq, maxq) * scale
+    # STE: forward = deq, backward = identity
+    return (w32 + jax.lax.stop_gradient(deq - w32)).astype(w.dtype)
+
+
+def _is_weight(path, leaf) -> bool:
+    """Dense/conv kernels only: >=2-D and named kernel/embedding-ish."""
+    if not hasattr(leaf, "ndim") or leaf.ndim < 2:
+        return False
+    names = [str(getattr(k, "key", k)) for k in path]
+    return any("kernel" in n or "embedding" in n.lower() for n in names) or True
+
+
+def quantize_tree_int8(params) -> Any:
+    """PTQ a param pytree: each eligible weight becomes
+    {"_q8": int8, "_scale": fp32}; everything else passes through."""
+    def one(path, leaf):
+        if not _is_weight(path, leaf):
+            return leaf
+        q, s = quantize_int8(leaf)
+        return {"_q8": q, "_scale": s}
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def dequantize_tree_int8(tree, dtype=jnp.float32):
+    """Inverse of quantize_tree_int8 (leaves the original dtype choice to
+    the caller — serving usually wants bf16)."""
+    def is_q(x):
+        return isinstance(x, dict) and set(x) == {"_q8", "_scale"}
+
+    return jax.tree.map(
+        lambda x: dequantize_int8(x["_q8"], x["_scale"], dtype) if is_q(x) else x,
+        tree,
+        is_leaf=is_q,
+    )
+
+
+def fake_quant_tree(params, bits: int = 8):
+    """QAT: fake-quantize every eligible weight in a param tree."""
+    return jax.tree_util.tree_map_with_path(
+        lambda p, l: fake_quant(l, bits) if _is_weight(p, l) else l, params
+    )
