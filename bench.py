@@ -2,6 +2,8 @@
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "tokens/s", "vs_baseline": N/16260}
+plus `mfu` and `tflops_per_chip` in the detail block (the BASELINE.json
+north-star metric is MFU; the A100 tokens/s row is the vs_baseline anchor).
 
 Baseline: the reference's GPT-345M single-card number — ~16,260 tokens/s on
 one A100-40G (BASELINE.md row 2, projects/gpt/docs/single_card.md:41-49).
@@ -18,10 +20,40 @@ import numpy as np
 
 BASELINE_TOKENS_PER_SEC = 16260.0  # A100-40G, reference single_card.md
 
+# Peak dense bf16 FLOP/s per chip by device kind (public spec sheets).
+_PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v4 lite": 138e12,   # v4i
+    "TPU v3": 123e12,
+    "TPU v6 lite": 918e12,   # Trillium
+    "TPU v6e": 918e12,
+    "cpu": 1e12,             # placeholder so CPU smoke runs don't div0
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    # longest-prefix match so 'TPU v4 lite' resolves before 'TPU v4'
+    for name in sorted(_PEAK_FLOPS, key=len, reverse=True):
+        if kind.startswith(name):
+            return _PEAK_FLOPS[name]
+    return 197e12  # unknown accelerator: assume v5e-class
+
+
+def model_flops_per_token(n_params: int, num_layers: int, seq: int, hidden: int) -> float:
+    """Standard 'model FLOPs' accounting (no rematerialisation counted):
+    6 FLOPs per parameter per token (fwd 2 + bwd 4, tied-embedding logits
+    included via the shared weight) + causal attention score/value matmuls
+    (fwd 4*s*h per layer per token, halved for causality, x3 for fwd+bwd)."""
+    return 6.0 * n_params + num_layers * 6.0 * seq * hidden
+
 
 def main():
     import jax
-    import jax.numpy as jnp
 
     from fleetx_tpu.core.engine import Trainer
     from fleetx_tpu.models import build_module
@@ -32,6 +64,11 @@ def main():
     batch = int(os.environ.get("BENCH_BATCH", 8))
     steps = int(os.environ.get("BENCH_STEPS", 10))
     warmup = int(os.environ.get("BENCH_WARMUP", 3))
+    # The reference's own large-model configs pick selective recompute
+    # (pretrain_gpt_175B_mp8_pp16.yaml recompute_granularity=core_attn);
+    # "full" remat costs an extra forward pass per step.
+    recompute = os.environ.get("BENCH_RECOMPUTE", "1") == "1"
+    granularity = os.environ.get("BENCH_GRANULARITY", "core_attn")
 
     cfg = AttrDict(
         Global=AttrDict(seed=0, local_batch_size=batch, micro_batch_size=batch),
@@ -53,10 +90,8 @@ def main():
             attention_probs_dropout_prob=0.1,
             fuse_attn_qkv=True,
             use_flash_attention=True,
-            # one v5e chip has 16G HBM vs the baseline A100's 40G: remat the
-            # layer stack to fit the same batch
-            use_recompute=os.environ.get("BENCH_RECOMPUTE", "1") == "1",
-            recompute_granularity="full",
+            use_recompute=recompute,
+            recompute_granularity=granularity,
         ),
         Optimizer=AttrDict(
             name="FusedAdamW",
@@ -83,6 +118,10 @@ def main():
     step_fn = trainer._get("train", trainer._build_train_step)
     db = trainer._shard_batch(host_batch)
 
+    n_params = sum(
+        int(np.prod(x.shape)) for x in jax.tree.leaves(trainer.state.params)
+    )
+
     state = trainer.state
     for i in range(warmup):
         state, metrics = step_fn(state, db, dist_env.data_rank_key(i))
@@ -96,6 +135,12 @@ def main():
 
     tokens_per_sec = gbs * seq * steps / dt
     n_chips = jax.device_count()
+    flops_per_token = model_flops_per_token(
+        n_params, cfg.Model.num_layers, seq, cfg.Model.hidden_size
+    )
+    achieved_flops = tokens_per_sec * flops_per_token
+    peak = _peak_flops(jax.devices()[0]) * n_chips
+    mfu = achieved_flops / peak
     print(
         json.dumps(
             {
@@ -105,11 +150,16 @@ def main():
                 "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 4),
                 "detail": {
                     "chips": n_chips,
+                    "device": getattr(jax.devices()[0], "device_kind", "?"),
                     "global_batch": gbs,
                     "seq_len": seq,
                     "steps": steps,
                     "step_time_s": round(dt / steps, 4),
                     "loss": round(final_loss, 4),
+                    "mfu": round(mfu, 4),
+                    "tflops_per_chip": round(achieved_flops / n_chips / 1e12, 2),
+                    "model_flops_per_token": round(flops_per_token / 1e9, 3),
+                    "recompute": f"{recompute}:{granularity}",
                     "baseline": "A100-40G 16260 tokens/s (reference single_card.md)",
                 },
             }

@@ -72,24 +72,30 @@ def causal_attention(
 ) -> jax.Array:
     """Multi-head scaled-dot-product attention, [b, s, h, d] layout.
 
-    Routes to the Pallas flash kernel when profitable (TPU, no attention
-    dropout, no custom mask, train-time shapes); falls back to the XLA path
-    otherwise. Both paths produce identical math (kernel is tested against
-    this reference implementation).
+    Routes to the Pallas flash kernel when profitable (TPU, no custom mask,
+    train-time shapes); falls back to the XLA path otherwise. Attention
+    dropout runs inside the kernel (hash-based mask, see
+    fleetx_tpu/ops/pallas/flash_attention.py), so dropout>0 training configs
+    stay on the flash path. Both paths produce identical math in the
+    deterministic case (kernel is tested against this reference
+    implementation).
     """
+    effective_dropout = 0.0 if deterministic else dropout_rate
     can_flash = (
         use_flash
         and causal
         and attn_mask is None
-        and (dropout_rate == 0.0 or deterministic)
+        and (effective_dropout == 0.0 or dropout_rng is not None)
         and q.shape[1] == k.shape[1]  # not incremental decode
-        and q.shape[1] >= 128  # kernel block size
+        and q.shape[1] % 128 == 0  # tileable by the kernel block size
         and jax.default_backend() in ("tpu", "axon")
     )
     if can_flash:
         from fleetx_tpu.ops.pallas.flash_attention import flash_attention
 
-        return flash_attention(q, k, v)
+        return flash_attention(
+            q, k, v, dropout_rate=effective_dropout, dropout_rng=dropout_rng
+        )
     return _reference_attention(
         q,
         k,

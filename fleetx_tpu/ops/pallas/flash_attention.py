@@ -7,6 +7,15 @@ dygraph/single_model.py:216-240 ``core_attn`` +
 the [s, s] score matrix out of HBM entirely, so long sequences don't need the
 reference's ``recompute_granularity=core_attn`` memory workaround.
 
+Attention dropout runs *inside* the kernel: a counter-based integer hash
+(lowbias32 finalizer) of (seed, batch*head, q_pos, k_pos) produces the keep
+mask, so the backward kernels regenerate the identical mask from the same
+seed with zero extra HBM traffic — the reference reaches the same
+determinism via its CUDA RNG tracker ``local_seed``
+(/root/reference/ppfleetx/distributed/apis/env.py:49-54). The hash is plain
+int32 arithmetic, so the kernel behaves identically under the Pallas
+interpreter on CPU (where pltpu.prng_* has no lowering) and on real TPUs.
+
 Layout: q, k, v are [batch, seq, heads, head_dim] (model layout); kernels run
 per (batch*head) over q-row blocks, scanning k-column blocks up to the causal
 diagonal. fp32 accumulation, inputs any float dtype.
@@ -15,7 +24,9 @@ diagonal. fp32 accumulation, inputs any float dtype.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -28,15 +39,49 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
 
+# lowbias32 mixing constants (public-domain integer hash); stored as wrapped
+# int32 because Pallas TPU integer math is int32.
+_MIX1 = np.int32(np.uint32(0x7FEB352D))
+_MIX2 = np.int32(np.uint32(0x846CA68B))
+_C1 = np.int32(np.uint32(0x9E3779B1))
+_C2 = np.int32(np.uint32(0x85EBCA77))
+_C3 = np.int32(np.uint32(0xC2B2AE3D))
+
 
 def _interpret() -> bool:
     """Pallas interpreter mode off-TPU (CPU tests of kernel math)."""
     return jax.default_backend() not in ("tpu", "axon")
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, scale: float):
+def _shr(x, n):
+    return jax.lax.shift_right_logical(x, jnp.int32(n))
+
+
+def dropout_keep_scale(seed, bh, q_pos, k_pos, rate: float):
+    """Deterministic dropout scale in {0, 1/(1-rate)} for each (q, k) cell.
+
+    seed: int32 scalar; bh: int32 scalar batch*head index; q_pos/k_pos: int32
+    grids of global positions (any broadcast-compatible shapes). Pure int32
+    jnp ops so forward/backward kernels (and test references) can regenerate
+    the exact mask.
+    """
+    x = q_pos * _C1 + k_pos * _C2 + bh * _C3 + seed
+    x = x ^ _shr(x, 16)
+    x = x * _MIX1
+    x = x ^ _shr(x, 15)
+    x = x * _MIX2
+    x = x ^ _shr(x, 16)
+    # 31 uniform bits; drop iff below the threshold.
+    threshold = jnp.int32(int(rate * (1 << 31)))
+    keep = (x & jnp.int32(0x7FFFFFFF)) >= threshold
+    return keep.astype(jnp.float32) / (1.0 - rate)
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                *, block_k: int, scale: float, dropout_rate: float):
     """One (batch*head, q-block) program: online softmax over k blocks."""
     bq, d = q_ref.shape
+    bh = pl.program_id(0)
     i = pl.program_id(1)
     q = q_ref[:].astype(jnp.float32) * scale
 
@@ -59,7 +104,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, scale: flo
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
+        # The softmax normalizer sums the *undropped* probabilities; dropout
+        # scales only the value-weighted path (out = dropout(softmax(s)) @ v).
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            p = p * dropout_keep_scale(seed_ref[0], bh, q_pos, k_pos, dropout_rate)
         acc_new = alpha * acc + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -71,17 +120,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, scale: flo
     m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
 
     o_ref[:] = (acc / l).astype(o_ref.dtype)
-    lse_ref[:] = (m + jnp.log(l)).reshape(lse_ref.shape)
+    lse_ref[:] = m + jnp.log(l)  # [bq, 1] tile of the (bh, s, 1) array
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, block_k: int, scale: float):
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, block_k: int, scale: float, dropout_rate: float):
     bq, d = q_ref.shape
+    bh = pl.program_id(0)
     i = pl.program_id(1)
     q = q_ref[:].astype(jnp.float32) * scale
     do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:].reshape(bq, 1)
-    delta = delta_ref[:].reshape(bq, 1)
+    lse = lse_ref[:]      # [bq, 1]
+    delta = delta_ref[:]  # [bq, 1]
     q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
 
     def body(j, dq):
@@ -96,6 +146,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
+        if dropout_rate > 0.0:
+            # dP = (dO @ V^T) ∘ mask; delta already equals rowsum(P ∘ dP)
+            # because delta = rowsum(dO ∘ O) and O = (P ∘ mask) @ V.
+            dp = dp * dropout_keep_scale(seed_ref[0], bh, q_pos, k_pos, dropout_rate)
         ds = p * (dp - delta)
         return dq + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -106,9 +160,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, block_q: int, scale: float, seq_len: int):
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q: int, scale: float, seq_len: int,
+                    dropout_rate: float):
     bk, d = k_ref.shape
+    bh = pl.program_id(0)
     j = pl.program_id(1)
     k = k_ref[:].astype(jnp.float32)
     v = v_ref[:].astype(jnp.float32)
@@ -121,19 +177,25 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         i = j * bk // block_q + ii
         q_blk = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
         do_blk = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(i * block_q, block_q)].reshape(block_q, 1)
-        delta = delta_ref[pl.ds(i * block_q, block_q)].reshape(block_q, 1)
+        lse = lse_ref[pl.ds(i * block_q, block_q), :]      # [block_q, 1]
+        delta = delta_ref[pl.ds(i * block_q, block_q), :]  # [block_q, 1]
         s = jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)
-        dv = dv + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
         dp = jax.lax.dot_general(
             do_blk, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if dropout_rate > 0.0:
+            drop = dropout_keep_scale(seed_ref[0], bh, q_pos, k_pos, dropout_rate)
+            p_v = p * drop  # dropped probabilities feed dV
+            dp = dp * drop
+        else:
+            p_v = p
+        dv = dv + jax.lax.dot_general(
+            p_v, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta)
         dk = dk + jax.lax.dot_general(
@@ -163,79 +225,94 @@ def _from_bh(x, b, h):
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-def _fwd_call(q3, k3, v3, block_q, block_k, scale):
+def _seed_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _fwd_call(seed, q3, k3, v3, block_q, block_k, scale, dropout_rate):
     bh, s, d = q3.shape
     grid = (bh, s // block_q)
-    kernel = functools.partial(_fwd_kernel, block_k=block_k, scale=scale)
+    kernel = functools.partial(
+        _fwd_kernel, block_k=block_k, scale=scale, dropout_rate=dropout_rate
+    )
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
+            _seed_spec(),
             pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            # trailing singleton dim: Mosaic requires the last block dim to
+            # divide 128 or equal the array dim — (block_q, 1) satisfies it
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q3, k3, v3)
+    )(seed, q3, k3, v3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, seed, block_q, block_k, dropout_rate):
+    out, _ = _flash_fwd(q, k, v, seed, block_q, block_k, dropout_rate)
     return out
 
 
-def _flash_fwd(q, k, v, block_q, block_k):
+def _flash_fwd(q, k, v, seed, block_q, block_k, dropout_rate):
     b, s, h, d = q.shape
     scale = 1.0 / (d**0.5)
     q3, k3, v3 = _to_bh(q), _to_bh(k), _to_bh(v)
-    o3, lse = _fwd_call(q3, k3, v3, block_q, block_k, scale)
-    return _from_bh(o3, b, h), (q3, k3, v3, o3, lse, b, h)
+    o3, lse = _fwd_call(seed, q3, k3, v3, block_q, block_k, scale, dropout_rate)
+    return _from_bh(o3, b, h), (q3, k3, v3, o3, lse, seed, b, h)
 
 
-def _flash_bwd(block_q, block_k, res, g):
-    q3, k3, v3, o3, lse, b, h = res
+def _flash_bwd(block_q, block_k, dropout_rate, res, g):
+    q3, k3, v3, o3, lse, seed, b, h = res
     bh, s, d = q3.shape
     scale = 1.0 / (d**0.5)
     do3 = _to_bh(g)
-    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # [bh, s, 1]
 
     dq3 = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, block_k=block_k, scale=scale),
+        functools.partial(
+            _bwd_dq_kernel, block_k=block_k, scale=scale, dropout_rate=dropout_rate
+        ),
         grid=(bh, s // block_q),
         in_specs=[
+            _seed_spec(),
             pl.BlockSpec((None, block_q, d), lambda b_, i: (b_, i, 0)),
             pl.BlockSpec((None, s, d), lambda b_, i: (b_, 0, 0)),
             pl.BlockSpec((None, s, d), lambda b_, i: (b_, 0, 0)),
             pl.BlockSpec((None, block_q, d), lambda b_, i: (b_, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b_, i: (b_, i)),
-            pl.BlockSpec((None, block_q), lambda b_, i: (b_, i)),
+            pl.BlockSpec((None, block_q, 1), lambda b_, i: (b_, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b_, i: (b_, i, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda b_, i: (b_, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
         interpret=_interpret(),
-    )(q3, k3, v3, do3, lse, delta)
+    )(seed, q3, k3, v3, do3, lse, delta)
 
     dk3, dv3 = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, block_q=block_q, scale=scale, seq_len=s
+            _bwd_dkv_kernel, block_q=block_q, scale=scale, seq_len=s,
+            dropout_rate=dropout_rate,
         ),
         grid=(bh, s // block_k),
         in_specs=[
+            _seed_spec(),
             pl.BlockSpec((None, s, d), lambda b_, j: (b_, 0, 0)),
             pl.BlockSpec((None, block_k, d), lambda b_, j: (b_, j, 0)),
             pl.BlockSpec((None, block_k, d), lambda b_, j: (b_, j, 0)),
             pl.BlockSpec((None, s, d), lambda b_, j: (b_, 0, 0)),
-            pl.BlockSpec((None, s), lambda b_, j: (b_, 0)),
-            pl.BlockSpec((None, s), lambda b_, j: (b_, 0)),
+            pl.BlockSpec((None, s, 1), lambda b_, j: (b_, 0, 0)),
+            pl.BlockSpec((None, s, 1), lambda b_, j: (b_, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda b_, j: (b_, j, 0)),
@@ -246,13 +323,14 @@ def _flash_bwd(block_q, block_k, res, g):
             jax.ShapeDtypeStruct((bh, s, d), v3.dtype),
         ],
         interpret=_interpret(),
-    )(q3, k3, v3, do3, lse, delta)
+    )(seed, q3, k3, v3, do3, lse, delta)
 
-    return _from_bh(dq3, *_bh_dims(res)), _from_bh(dk3, *_bh_dims(res)), _from_bh(dv3, *_bh_dims(res))
-
-
-def _bh_dims(res):
-    return res[5], res[6]
+    dq = _from_bh(dq3, b, h)
+    dk = _from_bh(dk3, b, h)
+    dv = _from_bh(dv3, b, h)
+    # seed is integer-dtype: its cotangent type is float0
+    dseed = np.zeros(seed.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dseed
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -264,13 +342,23 @@ def flash_attention(
     v: jax.Array,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    *,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Causal flash attention, [b, s, h, d] layout. Sequence length must be a
     multiple of the block sizes (callers fall back to the XLA path
-    otherwise — fleetx_tpu/ops/attention.py)."""
+    otherwise — fleetx_tpu/ops/attention.py). ``dropout_rate > 0`` requires a
+    ``dropout_rng`` key; the mask is generated inside the kernel."""
     s = q.shape[1]
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if s % block_q or s % block_k or block_q % block_k:
         raise ValueError(f"seq {s} not tileable by ({block_q}, {block_k})")
-    return _flash(q, k, v, block_q, block_k)
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout_rate > 0 requires dropout_rng")
+        seed = jax.random.bits(dropout_rng, (1,), "uint32").astype(jnp.int32)
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
+    return _flash(q, k, v, seed, block_q, block_k, float(dropout_rate))
