@@ -30,7 +30,7 @@ from fleetx_tpu.models.module import BasicModule
 from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
 from fleetx_tpu.optims.optimizer import build_optimizer
 from fleetx_tpu.parallel import env as dist_env
-from fleetx_tpu.parallel.mesh import DATA_AXES, MeshConfig, build_mesh
+from fleetx_tpu.parallel.mesh import DATA_AXES, MeshConfig, build_mesh, use_mesh
 from fleetx_tpu.parallel.sharding import make_rules, param_shardings
 from fleetx_tpu.utils.log import logger
 
@@ -190,10 +190,10 @@ class Trainer:
 
         import flax.linen as nn
 
-        with self.mesh, nn.logical_axis_rules(list(self.rules)):
+        with use_mesh(self.mesh), nn.logical_axis_rules(list(self.rules)):
             abstract = jax.eval_shape(_init, self.root_key)
         shardings = self._state_shardings(abstract)
-        with self.mesh, nn.logical_axis_rules(list(self.rules)):
+        with use_mesh(self.mesh), nn.logical_axis_rules(list(self.rules)):
             init_fn = jax.jit(_init, out_shardings=shardings)
             self.state = init_fn(self.root_key)
         self._state_sharding_tree = shardings
@@ -208,38 +208,76 @@ class Trainer:
         self.n_params = n_params
         return self.state
 
+    @staticmethod
+    def _path_keys(path) -> tuple:
+        """Normalize a jax key path to a tuple of strings."""
+        out = []
+        for k in path:
+            for attr in ("key", "idx", "name"):
+                if hasattr(k, attr):
+                    out.append(str(getattr(k, attr)))
+                    break
+            else:
+                out.append(str(k))
+        return tuple(out)
+
     def _state_shardings(self, abstract: TrainState):
         ps = param_shardings(abstract.params, self.mesh, self.rules)
 
-        def opt_shard(leaf):
+        # Index param specs by their *tree path*, and match optimizer-state
+        # leaves by path suffix: optax moment trees (mu/nu, ...) mirror the
+        # param tree under transform-specific prefixes, so the param path is
+        # always a suffix of the moment path. Matching by path (not by
+        # (shape, dtype)) keeps two same-shaped params with different
+        # shardings from colliding.
+        flat_params = jax.tree_util.tree_flatten_with_path(_unbox(abstract.params))[0]
+        flat_specs = [s.spec for s in jax.tree.leaves(ps)]
+        spec_by_path = {}
+        for (path, leaf), spec in zip(flat_params, flat_specs):
+            spec_by_path[self._path_keys(path)] = (leaf.shape, spec)
+
+        # `sharding_offload` (reference sharding.py CPU offload) = optimizer
+        # moments live in host memory; XLA streams them across PCIe at the
+        # update. Only TPU backends lower the placement annotation.
+        offload = bool(getattr(self.mesh_cfg, "sharding_offload", False))
+        if offload and jax.default_backend() not in ("tpu", "axon"):
+            raise NotImplementedError(
+                "Distributed.sharding.sharding_offload=True needs a TPU "
+                "backend (host memory placement is not lowered on "
+                f"{jax.default_backend()!r})"
+            )
+        def shard_like_param(path, leaf, kind):
             """Moment tensors mirror the matching param sharding; ZeRO-1/2
             additionally shards moments over fsdp (stage 3 already shards the
-            params themselves). Scalars replicate."""
+            params themselves). Scalars and unmatched leaves replicate."""
             if not hasattr(leaf, "shape") or leaf.ndim == 0:
-                return NamedSharding(self.mesh, P())
-            spec = self._param_spec_by_shape.get((leaf.shape, leaf.dtype))
+                return NamedSharding(self.mesh, P(), **kind)
+            keys = self._path_keys(path)
+            spec = None
+            for start in range(len(keys)):
+                hit = spec_by_path.get(keys[start:])
+                if hit is not None and hit[0] == leaf.shape:
+                    spec = hit[1]
+                    break
             if spec is None:
-                return NamedSharding(self.mesh, P())
+                return NamedSharding(self.mesh, P(), **kind)
             if self.mesh_cfg.sharding_stage in (1, 2) and self.mesh_cfg.fsdp > 1:
                 spec = self._add_fsdp(spec, leaf.shape)
-            return NamedSharding(self.mesh, spec)
+            return NamedSharding(self.mesh, spec, **kind)
 
-        # index param specs by (shape,dtype) so optax moment trees (which
-        # mirror param structure but are nested differently per transform)
-        # can be matched leaf-wise.
-        flat_params = jax.tree.leaves(_unbox(abstract.params))
-        flat_specs = [s.spec for s in jax.tree.leaves(ps)]
-        self._param_spec_by_shape = {
-            (p.shape, p.dtype): s for p, s in zip(flat_params, flat_specs)
-        }
-
-        opt_sh = jax.tree.map(opt_shard, abstract.opt_state)
-        # extra state (momentum encoders, queues): same shape-matching rule
-        # as optimizer moments — param-shaped leaves mirror the param
-        # sharding, everything else replicates.
+        opt_kind = {"memory_kind": "pinned_host"} if offload else {}
+        opt_sh = jax.tree_util.tree_map_with_path(
+            lambda p, l: shard_like_param(p, l, opt_kind), abstract.opt_state
+        )
+        # extra state (momentum encoders, queues): same path-matching rule —
+        # param-shaped leaves under a mirrored path get the param sharding,
+        # everything else replicates. Always on device: extra state feeds the
+        # forward pass, so host offload would stall every step.
         extra_sh = (
             None if abstract.extra is None
-            else jax.tree.map(opt_shard, abstract.extra)
+            else jax.tree_util.tree_map_with_path(
+                lambda p, l: shard_like_param(p, l, {}), abstract.extra
+            )
         )
         return TrainState(
             step=NamedSharding(self.mesh, P()), params=ps, opt_state=opt_sh,
@@ -293,13 +331,14 @@ class Trainer:
             P(None, DATA_AXES) if self.accumulate_steps > 1 else P(DATA_AXES)
         )
         batch_sh = NamedSharding(self.mesh, batch_spec)
-        with self.mesh:
-            return jax.jit(
-                train_step,
-                in_shardings=(sh, batch_sh, NamedSharding(self.mesh, P())),
-                out_shardings=(sh, NamedSharding(self.mesh, P())),
-                donate_argnums=(0,),
-            )
+        # no mesh context needed here: jax.jit only traces on first call,
+        # which _get() routes through _in_context()'s use_mesh wrapper
+        return jax.jit(
+            train_step,
+            in_shardings=(sh, batch_sh, NamedSharding(self.mesh, P())),
+            out_shardings=(sh, NamedSharding(self.mesh, P())),
+            donate_argnums=(0,),
+        )
 
     def _build_eval_step(self):
         module = self.module
@@ -315,12 +354,11 @@ class Trainer:
 
         sh = self._state_sharding_tree
         batch_sh = NamedSharding(self.mesh, P(DATA_AXES))
-        with self.mesh:
-            return jax.jit(
-                eval_step,
-                in_shardings=(sh, batch_sh),
-                out_shardings=NamedSharding(self.mesh, P()),
-            )
+        return jax.jit(
+            eval_step,
+            in_shardings=(sh, batch_sh),
+            out_shardings=NamedSharding(self.mesh, P()),
+        )
 
     def _get(self, name, builder):
         if name not in self._compiled:
@@ -333,7 +371,7 @@ class Trainer:
         import flax.linen as nn
 
         def call(*args, **kwargs):
-            with self.mesh, nn.logical_axis_rules(list(self.rules)):
+            with use_mesh(self.mesh), nn.logical_axis_rules(list(self.rules)):
                 return fn(*args, **kwargs)
 
         return call

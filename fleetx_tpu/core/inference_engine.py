@@ -60,13 +60,15 @@ class InferenceEngine:
             # activation constraints inside the model resolve via the rules
             from flax import linen as nn
 
+            from fleetx_tpu.parallel.mesh import use_mesh
             from fleetx_tpu.parallel.sharding import make_rules
 
             mesh, rules = self.mesh, make_rules()
+            jitted = jax.jit(fwd)  # one jit: retains its compile cache
 
             def sharded(params, batch):
-                with mesh, nn.logical_axis_rules(rules):
-                    return jax.jit(fwd)(params, batch)
+                with use_mesh(mesh), nn.logical_axis_rules(rules):
+                    return jitted(params, batch)
 
             self._forward = sharded
         else:

@@ -100,16 +100,22 @@ class ImagenModule(BasicModule):
 
     def serving_forward(self, input_spec):
         """Serve one UNet denoising step eps(x_t, t, text); samplers drive
-        it in a loop (ddpm_sample)."""
+        it in a loop (ddpm_sample). SR stages take the clean low-res
+        conditioning image as an explicit input — at serving time ``images``
+        is the *noisy* x_t, so the conditioning cannot be derived from it
+        the way training derives it from the clean target."""
         spec = {k: input_spec[k] for k in ("images", "text_embeds", "text_mask")}
         b = spec["images"].shape[0]
         spec["t"] = jax.ShapeDtypeStruct((b,), jnp.float32)
+        if self.unet_config.lowres_cond:
+            spec["lowres_cond_img"] = jax.ShapeDtypeStruct(
+                spec["images"].shape, jnp.float32
+            )
 
         def fn(p, feed):
-            images = feed["images"]
-            low = self._lowres(images) if self.unet_config.lowres_cond else None
+            low = feed.get("lowres_cond_img") if self.unet_config.lowres_cond else None
             return self.nets.apply(
-                {"params": p}, images, feed["t"], feed.get("text_embeds"),
+                {"params": p}, feed["images"], feed["t"], feed.get("text_embeds"),
                 feed.get("text_mask"), low,
             )
 

@@ -62,7 +62,7 @@ def _is_weight(path, leaf) -> bool:
     if not hasattr(leaf, "ndim") or leaf.ndim < 2:
         return False
     names = [str(getattr(k, "key", k)) for k in path]
-    return any("kernel" in n or "embedding" in n.lower() for n in names) or True
+    return any("kernel" in n or "embedding" in n.lower() for n in names)
 
 
 def quantize_tree_int8(params) -> Any:

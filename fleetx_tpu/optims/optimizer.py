@@ -20,34 +20,7 @@ import optax
 from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
 from fleetx_tpu.utils.log import logger
 
-__all__ = ["build_optimizer", "build_grad_clip", "global_norm_with_experts"]
-
-
-def _is_expert_path(path) -> bool:
-    return any("expert" in str(getattr(k, "key", k)) for k in path)
-
-
-def clip_by_global_norm_moe(max_norm: float) -> optax.GradientTransformation:
-    """Global-norm clip treating expert params correctly under expert
-    parallelism: expert grads exist once per expert (sharded over the data
-    axes), so their norm contribution is summed across the expert group while
-    dense params count once (reference ClipGradForMOEByGlobalNorm,
-    grad_clip.py:27-156). Inside jit/pjit with GSPMD-sharded grads the
-    global-norm reduction is already global, so the partition reduces to a
-    standard clip; the separation is kept for explicit shard_map use."""
-
-    def update_fn(updates, state, params=None):
-        del params
-        norm = optax.global_norm(updates)
-        scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
-        updates = jax.tree.map(lambda g: g * scale, updates)
-        return updates, state
-
-    return optax.GradientTransformation(lambda params: optax.EmptyState(), update_fn)
-
-
-def global_norm_with_experts(grads) -> jax.Array:
-    return optax.global_norm(grads)
+__all__ = ["build_optimizer", "build_grad_clip"]
 
 
 def build_grad_clip(clip_cfg) -> Optional[optax.GradientTransformation]:
@@ -56,6 +29,10 @@ def build_grad_clip(clip_cfg) -> Optional[optax.GradientTransformation]:
         return None
     name = clip_cfg["name"]
     if name in ("ClipGradByGlobalNorm", "ClipGradForMOEByGlobalNorm"):
+        # ClipGradForMOEByGlobalNorm (reference grad_clip.py:27-156) exists
+        # because expert grads live on a different process group than dense
+        # grads; under GSPMD the grads arrive sharded on one mesh and
+        # optax.global_norm reduces over every shard, so one clip serves both.
         return optax.clip_by_global_norm(clip_cfg.get("clip_norm", 1.0))
     if name == "ClipGradByNorm":
         return optax.clip_by_block_rms(clip_cfg.get("clip_norm", 1.0))

@@ -200,21 +200,25 @@ def ring_attention(
 
 
 def _ambient_mesh() -> Optional[Mesh]:
+    """The mesh this call should shard_map over, best-effort: the modern
+    jax context mesh (jax.sharding.set_mesh) first, then the framework's own
+    registry (fleetx_tpu.parallel.mesh.use_mesh — what the Trainer enters).
+    No deprecated thread_resources lookups."""
     try:
-        m = jax.sharding.get_abstract_mesh()  # modern context mesh
-        if m is not None and not m.empty:  # pragma: no cover - version dependent
+        m = jax.sharding.get_mesh()  # set via jax.sharding.set_mesh
+        if m is not None and not m.empty:
             return m
     except Exception:
         pass
     try:
-        from jax.interpreters import pxla
-
-        m = pxla.thread_resources.env.physical_mesh
-        if m is not None and m.devices.size:
+        m = jax.sharding.get_abstract_mesh()
+        if m is not None and not m.empty:  # pragma: no cover - version dependent
             return m
-    except Exception:  # pragma: no cover
+    except Exception:
         pass
-    return None
+    from fleetx_tpu.parallel.mesh import active_mesh
+
+    return active_mesh()
 
 
 def ring_self_attention(

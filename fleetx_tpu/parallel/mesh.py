@@ -32,6 +32,8 @@ __all__ = [
     "MeshConfig",
     "build_mesh",
     "mesh_from_config",
+    "use_mesh",
+    "active_mesh",
     "DATA_AXES",
     "get_data_world",
     "batch_sharding",
@@ -50,6 +52,7 @@ class MeshConfig:
     pp: int = 1
     cp: int = 1
     sharding_stage: int = 1
+    sharding_offload: bool = False  # opt-state in host memory (pinned_host)
 
     @property
     def nranks(self) -> int:
@@ -66,6 +69,7 @@ class MeshConfig:
             pp=dist.get("pp_degree") or 1,
             cp=dist.get("cp_degree") or 1,
             sharding_stage=sharding.get("sharding_stage") or 1,
+            sharding_offload=bool(sharding.get("sharding_offload")),
         )
 
 
@@ -109,3 +113,38 @@ def get_data_world(mesh: Mesh) -> int:
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for host-fed batches: batch dim over the data axes."""
     return NamedSharding(mesh, P(DATA_AXES))
+
+
+# ------------------------------------------------------------- mesh context
+# jax's legacy `with mesh:` context is only observable through the deprecated
+# `pxla.thread_resources`; the modern `jax.sharding.get_mesh()` only sees
+# meshes installed via `jax.sharding.set_mesh`. The framework keeps its own
+# tiny registry so code deep inside a jitted model (ring attention,
+# context_parallel.py) can find the mesh the Trainer entered without any
+# deprecated API.
+
+import contextlib
+import contextvars
+
+# context-local (so threaded servers with different meshes don't cross-talk,
+# matching the thread-locality of jax's own mesh context)
+_ACTIVE_MESHES: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "fleetx_active_meshes", default=()
+)
+
+
+def active_mesh() -> Optional[Mesh]:
+    """Innermost mesh entered via :func:`use_mesh` (None outside)."""
+    stack = _ACTIVE_MESHES.get()
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Enter a mesh for GSPMD lowering AND record it for framework lookups."""
+    token = _ACTIVE_MESHES.set(_ACTIVE_MESHES.get() + (mesh,))
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _ACTIVE_MESHES.reset(token)

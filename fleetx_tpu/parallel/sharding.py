@@ -29,7 +29,6 @@ __all__ = [
     "make_rules",
     "logical_to_mesh_sharding",
     "param_shardings",
-    "opt_state_shardings",
     "with_logical_constraint",
 ]
 
@@ -97,13 +96,6 @@ def param_shardings(abstract_vars, mesh: Mesh, rules: Rules):
     ``nn.Partitioned`` logical-axis metadata (from nn.with_partitioning)."""
     logical_specs = nn.get_partition_spec(abstract_vars)
     return logical_to_mesh_sharding(logical_specs, mesh, rules)
-
-
-def opt_state_shardings(opt_state_shape, param_sharding_fn):
-    """Shardings for optax optimizer state: moment tensors mirror their
-    parameter's sharding (possibly upgraded to fsdp for ZeRO-1/2); scalars
-    replicate."""
-    raise NotImplementedError  # built alongside the trainer
 
 
 def with_logical_constraint(x, logical_axes: Tuple[Optional[str], ...]):

@@ -27,13 +27,18 @@ from fleetx_tpu.utils.log import logger
 
 def _batched(dataset, batch_size):
     """Stack dict samples into fixed-size batches (last partial dropped —
-    matches reference eval batching)."""
+    matches reference eval batching, but loudly)."""
     batch = []
     for i in range(len(dataset)):
         batch.append(dataset[i])
         if len(batch) == batch_size:
             yield {k: np.stack([s[k] for s in batch]) for k in batch[0]}
             batch = []
+    if batch:
+        logger.warning(
+            "dropping final partial eval batch of %d samples (< batch_size=%d)",
+            len(batch), batch_size,
+        )
 
 
 def _load_tokens(oe):
@@ -83,7 +88,13 @@ def offline_eval(cfg):
         )
 
     trainer = Trainer(cfg, module, mode="eval")
-    first = next(_batched(ds, batch_size))
+    try:
+        first = next(_batched(ds, batch_size))
+    except StopIteration:
+        raise SystemExit(
+            f"offline eval dataset has {len(ds)} samples — fewer than one "
+            f"batch of {batch_size}; lower Offline_Eval.batch_size"
+        ) from None
     trainer.init_state(first)
     if (cfg.Engine.save_load or {}).get("ckpt_dir"):
         trainer.load()
