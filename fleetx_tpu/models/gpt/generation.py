@@ -24,7 +24,7 @@ __all__ = ["GenerationConfig", "generate", "process_logits"]
 class GenerationConfig:
     max_length: int = 64  # new tokens to generate
     min_length: int = 0
-    decode_strategy: str = "sampling"  # 'greedy' | 'sampling'
+    decode_strategy: str = "sampling"  # 'greedy' | 'sampling' | 'beam_search'
     temperature: float = 1.0
     top_k: int = 0
     top_p: float = 1.0
@@ -32,6 +32,14 @@ class GenerationConfig:
     eos_token_id: int = 50256
     pad_token_id: int = 50256
     forced_eos_token_id: Optional[int] = None
+    # beam search (reference config surface single_model.py:803-818)
+    num_beams: int = 1
+    num_beam_groups: int = 1
+    diversity_rate: float = 0.0
+    length_penalty: float = 0.0
+    early_stopping: bool = False
+    forced_bos_token_id: Optional[int] = None
+    num_return_sequences: int = 1
 
     @classmethod
     def from_config(cls, gen_cfg) -> "GenerationConfig":
@@ -119,6 +127,14 @@ def generate(
     pad): pad slots are never attended to, and position ids are shifted so
     each row's first real token sits at position 0.
     """
+    if gen_cfg.decode_strategy == "beam_search":
+        from fleetx_tpu.models.gpt.beam_search import beam_search
+
+        out = beam_search(model, variables, jnp.asarray(input_ids), gen_cfg,
+                          attention_mask=attention_mask)
+        # flatten [b, num_return_sequences, L] to the reference's
+        # expand_inputs_for_generation row layout [b*nret, L]
+        return out.reshape(-1, out.shape[-1])
     if rng is None:
         rng = jax.random.PRNGKey(0)
     b, prompt_len = input_ids.shape
