@@ -156,11 +156,13 @@ class Trainer:
         dist = cfg.Distributed or {}
         self.mesh_cfg = MeshConfig.from_dist_config(dist)
         self.mesh = build_mesh(self.mesh_cfg)
+        from fleetx_tpu.parallel.dap import dap_rules
+
         self.rules = make_rules(
             sharding_stage=self.mesh_cfg.sharding_stage,
             sequence_parallel=bool((cfg.Model or {}).get("sequence_parallel")),
             context_parallel=self.mesh_cfg.cp > 1,
-        )
+        ) + dap_rules()  # folding-trunk axial layout rides the cp axis
 
         self.root_key = dist_env.set_seed(glb.seed)
         self.lr_schedule = build_lr_scheduler((cfg.Optimizer or {}).get("lr", 1e-4))

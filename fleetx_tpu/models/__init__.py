@@ -44,6 +44,7 @@ def _populate():
         ("MOCOModule", "fleetx_tpu.models.moco_module", "MOCOModule"),
         ("ErnieModule", "fleetx_tpu.models.ernie_module", "ErnieModule"),
         ("ImagenModule", "fleetx_tpu.models.imagen_module", "ImagenModule"),
+        ("ProteinFoldingModule", "fleetx_tpu.models.protein_module", "ProteinFoldingModule"),
     ]:
         try:
             mod = __import__(path, fromlist=[attr])

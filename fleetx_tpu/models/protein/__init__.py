@@ -2,4 +2,14 @@ from fleetx_tpu.models.protein.evoformer import (  # noqa: F401
     EvoformerConfig,
     EvoformerIteration,
     EvoformerStack,
+    GlobalAttention,
+    MSAColumnGlobalAttention,
+)
+from fleetx_tpu.models.protein.folding import (  # noqa: F401
+    DistEmbeddingsAndEvoformer,
+    FoldingConfig,
+)
+from fleetx_tpu.models.protein.template import (  # noqa: F401
+    TemplateConfig,
+    TemplateEmbedding,
 )

@@ -38,7 +38,13 @@ from fleetx_tpu.parallel.dap import (
 
 Dtype = Any
 
-__all__ = ["EvoformerConfig", "EvoformerIteration", "EvoformerStack"]
+__all__ = [
+    "EvoformerConfig",
+    "EvoformerIteration",
+    "EvoformerStack",
+    "GlobalAttention",
+    "MSAColumnGlobalAttention",
+]
 
 BIG_NEG = -1e9
 
@@ -54,6 +60,9 @@ class EvoformerConfig:
     outer_product_dim: int = 32
     triangle_mult_dim: int = 128
     num_layers: int = 48
+    # extra-MSA stack variant (AlphaFold Suppl. Alg. 18): column attention
+    # becomes global (mean-query) attention over the deep MSA axis
+    global_column_attention: bool = False
     gating: bool = True
     use_recompute: bool = False
     scan_layers: bool = True
@@ -166,6 +175,67 @@ class MSAColumnAttention(nn.Module):
         mask_bias = (1.0 - m[:, :, None, None, :]) * BIG_NEG
         out = GatedAttention(c, c.num_heads_msa, c.msa_channel, name="attn")(
             x, x, mask_bias
+        )
+        return jnp.swapaxes(out, -2, -3)
+
+
+class GlobalAttention(nn.Module):
+    """Mean-query global attention (reference attentions.py:150-241
+    GlobalAttention; Suppl. Alg. 19 lines 2-7): queries are averaged over
+    the attended axis, keys/values are single-head, gating restores a
+    per-position output."""
+
+    cfg: EvoformerConfig
+    num_heads: int
+    out_dim: int
+
+    @nn.compact
+    def __call__(self, q_data, m_data, q_mask):
+        nh = self.num_heads
+        dt = self.cfg.dtype
+        ch = q_data.shape[-1]
+        hd = ch // nh
+        q_data = q_data.astype(dt)
+        m_data = m_data.astype(dt)
+        k = _dense(hd, "key_w", use_bias=False, dtype=dt)(m_data)
+        v = _dense(hd, "value_w", use_bias=False, dtype=dt)(m_data)
+        denom = jnp.sum(q_mask, axis=-1, keepdims=True) + 1e-10  # [..., 1]
+        q_avg = jnp.sum(q_data * q_mask[..., None].astype(dt), axis=-2) / denom.astype(dt)
+        q = _dense((nh, hd), "query_w", use_bias=False, dtype=dt)(q_avg) * hd ** -0.5
+        bias = ((1.0 - q_mask) * BIG_NEG)[..., None, :]  # [..., 1, K]
+        logits = jnp.einsum("...hd,...kd->...hk", q, k,
+                            preferred_element_type=jnp.float32) + bias
+        weights = jax.nn.softmax(logits, axis=-1).astype(dt)
+        wa = jnp.einsum("...hk,...kd->...hd", weights, v)
+        if self.cfg.gating:
+            gate = jax.nn.sigmoid(
+                _dense((nh, hd), "gating_w", init="gate", dtype=dt)(q_data)
+            )  # [..., K, h, d]
+            out = wa[..., None, :, :] * gate
+        else:
+            out = wa[..., None, :, :]
+        return nn.DenseGeneral(
+            features=self.out_dim, axis=(-2, -1), dtype=dt,
+            param_dtype=jnp.float32,
+            kernel_init=nn.initializers.zeros_init(), name="output_w",
+        )(out)
+
+
+class MSAColumnGlobalAttention(nn.Module):
+    """Column-wise global attention for the deep extra-MSA stack
+    (reference attentions.py:317-363)."""
+
+    cfg: EvoformerConfig
+
+    @nn.compact
+    def __call__(self, msa_act, msa_mask):
+        c = self.cfg
+        msa_act = col_sharded(msa_act)
+        x = jnp.swapaxes(msa_act, -2, -3)  # [B, R, S, C]
+        m = jnp.swapaxes(msa_mask, -1, -2).astype(jnp.float32)  # [B, R, S]
+        x = _ln("query_norm", c.dtype)(x.astype(c.dtype))
+        out = GlobalAttention(c, c.num_heads_msa, c.msa_channel, name="attn")(
+            x, x, m
         )
         return jnp.swapaxes(out, -2, -3)
 
@@ -283,9 +353,14 @@ class EvoformerIteration(nn.Module):
         msa_act = add(msa_act, MSARowAttentionWithPairBias(
             c, name="msa_row_attention_with_pair_bias"
         )(msa_act, msa_mask, pair_act))
-        msa_act = add(msa_act, MSAColumnAttention(c, name="msa_column_attention")(
-            msa_act, msa_mask
-        ))
+        if c.global_column_attention:
+            msa_act = add(msa_act, MSAColumnGlobalAttention(
+                c, name="msa_column_global_attention"
+            )(msa_act, msa_mask))
+        else:
+            msa_act = add(msa_act, MSAColumnAttention(
+                c, name="msa_column_attention"
+            )(msa_act, msa_mask))
         msa_act = add(msa_act, Transition(
             c, c.msa_transition_factor, name="msa_transition"
         )(msa_act))

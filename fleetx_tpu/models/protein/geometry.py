@@ -1,0 +1,112 @@
+"""Rigid-body 3D geometry for the folding trunk.
+
+Capability parity with the reference's r3.py (490 LoC) and quat_affine.py
+(613 LoC) (/root/reference/ppfleetx/models/protein_folding/), redesigned for
+XLA: where the reference carries structs-of-scalars (r3.Vecs with separate
+x/y/z tensors, 9-field Rots) to dodge framework overheads, here vectors are
+plain [..., 3] arrays and rotations [..., 3, 3] matrices — XLA fuses the
+small einsums and keeps everything vectorized, so the struct juggling buys
+nothing on TPU.
+
+Conventions: a rigid transform is the pair (rot [..., 3, 3], trans [..., 3])
+mapping local -> global points: g = rot @ l + trans.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+__all__ = [
+    "rigids_from_3_points",
+    "invert_rigid",
+    "apply_rigid",
+    "apply_inverse_rigid",
+    "rot_to_quat",
+    "quat_to_rot",
+    "make_transform_from_reference",
+]
+
+
+def rigids_from_3_points(point_on_neg_x_axis, origin, point_on_xy_plane,
+                         eps: float = 1e-8):
+    """Gram-Schmidt frame from three points (reference
+    r3.rigids_from_3_points_vecs; AlphaFold Suppl. Alg. 21): the x-axis
+    points from `point_on_neg_x_axis` to `origin`, the xy-plane contains
+    `point_on_xy_plane`. Returns (rot [..., 3, 3], trans [..., 3])."""
+    e0 = origin - point_on_neg_x_axis
+    e1 = point_on_xy_plane - origin
+    e0 = e0 / jnp.sqrt(jnp.sum(e0**2, -1, keepdims=True) + eps)
+    e1 = e1 - e0 * jnp.sum(e0 * e1, -1, keepdims=True)
+    e1 = e1 / jnp.sqrt(jnp.sum(e1**2, -1, keepdims=True) + eps)
+    e2 = jnp.cross(e0, e1)
+    rot = jnp.stack([e0, e1, e2], axis=-1)  # columns are the basis vectors
+    return rot, origin
+
+
+def invert_rigid(rot, trans):
+    inv_rot = jnp.swapaxes(rot, -1, -2)
+    inv_trans = -jnp.einsum("...ij,...j->...i", inv_rot, trans)
+    return inv_rot, inv_trans
+
+
+def apply_rigid(rot, trans, point):
+    """g = R @ p + t with broadcasting over leading dims."""
+    return jnp.einsum("...ij,...j->...i", rot, point) + trans
+
+
+def apply_inverse_rigid(rot, trans, point):
+    """R^T @ (p - t): maps a global point into the local frame (reference
+    QuatAffine.invert_point, quat_affine.py)."""
+    return jnp.einsum("...ji,...j->...i", rot, point - trans)
+
+
+def rot_to_quat(rot, unstack_inputs: bool = False):
+    """Rotation matrix [..., 3, 3] -> unit quaternion [..., 4] (w, x, y, z).
+
+    Uses the eigenvector-free branch selection of the reference
+    (quat_affine.py rot_to_quat): build the four squared-magnitude
+    candidates and normalize the largest for numerical safety."""
+    del unstack_inputs
+    xx, xy, xz = rot[..., 0, 0], rot[..., 0, 1], rot[..., 0, 2]
+    yx, yy, yz = rot[..., 1, 0], rot[..., 1, 1], rot[..., 1, 2]
+    zx, zy, zz = rot[..., 2, 0], rot[..., 2, 1], rot[..., 2, 2]
+    # 4 candidate quaternions, one per dominant component
+    qw = jnp.stack([1.0 + xx + yy + zz, zy - yz, xz - zx, yx - xy], -1)
+    qx = jnp.stack([zy - yz, 1.0 + xx - yy - zz, xy + yx, xz + zx], -1)
+    qy = jnp.stack([xz - zx, xy + yx, 1.0 - xx + yy - zz, yz + zy], -1)
+    qz = jnp.stack([yx - xy, xz + zx, yz + zy, 1.0 - xx - yy + zz], -1)
+    cands = jnp.stack([qw, qx, qy, qz], -2)  # [..., 4(cand), 4(quat)]
+    norms = jnp.sum(cands**2, -1)  # [..., 4]
+    best = jnp.argmax(norms, -1)
+    q = jnp.take_along_axis(cands, best[..., None, None].repeat(4, -1),
+                            axis=-2)[..., 0, :]
+    return q / jnp.linalg.norm(q, axis=-1, keepdims=True)
+
+
+def quat_to_rot(q):
+    """Unit quaternion [..., 4] (w, x, y, z) -> rotation matrix [..., 3, 3]."""
+    w, x, y, z = q[..., 0], q[..., 1], q[..., 2], q[..., 3]
+    r = jnp.stack(
+        [
+            1 - 2 * (y * y + z * z), 2 * (x * y - w * z), 2 * (x * z + w * y),
+            2 * (x * y + w * z), 1 - 2 * (x * x + z * z), 2 * (y * z - w * x),
+            2 * (x * z - w * y), 2 * (y * z + w * x), 1 - 2 * (x * x + y * y),
+        ],
+        axis=-1,
+    )
+    return r.reshape(q.shape[:-1] + (3, 3))
+
+
+def make_transform_from_reference(n_xyz, ca_xyz, c_xyz) -> Tuple:
+    """Backbone frame from N/CA/C coordinates (reference
+    quat_affine.make_transform_from_reference): CA at the origin, C on the
+    +x axis, N in the xy-plane with positive y. Returns (rot, trans) such
+    that apply_inverse_rigid maps global points into the residue frame."""
+    rot, trans = rigids_from_3_points(
+        point_on_neg_x_axis=2.0 * ca_xyz - c_xyz,  # C on +x
+        origin=ca_xyz,
+        point_on_xy_plane=n_xyz,
+    )
+    return rot, trans

@@ -1,0 +1,119 @@
+"""Amino-acid constants needed by the folding trunk.
+
+Capability parity with the reference's residue_constants
+(/root/reference/ppfleetx/models/protein_folding/residue_constants.py:1-961,
+itself the standard AlphaFold tables): this module keeps only what the
+trunk (template embedding + torsion-angle featurization, evoformer) consumes
+— residue type codes, the atom37 vocabulary, and the chi-angle definitions —
+and derives the derived tables (masks, index tensors) programmatically
+instead of hard-coding them. The underlying values are physical chemistry
+(PDB atom nomenclature and side-chain dihedral definitions), identical in
+any correct implementation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# one-letter codes in the canonical AlphaFold order
+restypes = [
+    "A", "R", "N", "D", "C", "Q", "E", "G", "H", "I",
+    "L", "K", "M", "F", "P", "S", "T", "W", "Y", "V",
+]
+restype_order = {r: i for i, r in enumerate(restypes)}
+restype_num = len(restypes)  # 20; UNK gets index 20
+unk_restype_index = restype_num
+
+restype_1to3 = {
+    "A": "ALA", "R": "ARG", "N": "ASN", "D": "ASP", "C": "CYS",
+    "Q": "GLN", "E": "GLU", "G": "GLY", "H": "HIS", "I": "ILE",
+    "L": "LEU", "K": "LYS", "M": "MET", "F": "PHE", "P": "PRO",
+    "S": "SER", "T": "THR", "W": "TRP", "Y": "TYR", "V": "VAL",
+}
+restype_3to1 = {v: k for k, v in restype_1to3.items()}
+
+# the 37 heavy-atom name vocabulary (atom37 layout); index = position in
+# the per-residue coordinate tensor. Backbone first: N, CA, C, CB, O.
+atom_types = [
+    "N", "CA", "C", "CB", "O", "CG", "CG1", "CG2", "OG", "OG1", "SG", "CD",
+    "CD1", "CD2", "ND1", "ND2", "OD1", "OD2", "SD", "CE", "CE1", "CE2",
+    "CE3", "NE", "NE1", "NE2", "OE1", "OE2", "CH2", "NH1", "NH2", "OH",
+    "CZ", "CZ2", "CZ3", "NZ", "OXT",
+]
+atom_order = {a: i for i, a in enumerate(atom_types)}
+atom_type_num = len(atom_types)  # 37
+
+# side-chain dihedral (chi) definitions: the 4 atoms spanning each rotatable
+# bond, per residue (PDB nomenclature; chi_k rotates about bond atoms 2-3)
+chi_angles_atoms = {
+    "ALA": [],
+    "ARG": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD"],
+            ["CB", "CG", "CD", "NE"], ["CG", "CD", "NE", "CZ"]],
+    "ASN": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "OD1"]],
+    "ASP": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "OD1"]],
+    "CYS": [["N", "CA", "CB", "SG"]],
+    "GLN": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD"],
+            ["CB", "CG", "CD", "OE1"]],
+    "GLU": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD"],
+            ["CB", "CG", "CD", "OE1"]],
+    "GLY": [],
+    "HIS": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "ND1"]],
+    "ILE": [["N", "CA", "CB", "CG1"], ["CA", "CB", "CG1", "CD1"]],
+    "LEU": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD1"]],
+    "LYS": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD"],
+            ["CB", "CG", "CD", "CE"], ["CG", "CD", "CE", "NZ"]],
+    "MET": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "SD"],
+            ["CB", "CG", "SD", "CE"]],
+    "PHE": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD1"]],
+    "PRO": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD"]],
+    "SER": [["N", "CA", "CB", "OG"]],
+    "THR": [["N", "CA", "CB", "OG1"]],
+    "TRP": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD1"]],
+    "TYR": [["N", "CA", "CB", "CG"], ["CA", "CB", "CG", "CD1"]],
+    "VAL": [["N", "CA", "CB", "CG1"]],
+}
+
+# chi angles whose terminal atom pair is chemically symmetric, making the
+# angle pi-periodic (ASP chi2, GLU chi3, PHE chi2, TYR chi2)
+_PI_PERIODIC = {("ASP", 1), ("GLU", 2), ("PHE", 1), ("TYR", 1)}
+
+
+@functools.cache
+def chi_angles_mask_array() -> np.ndarray:
+    """[21, 4] float32: which chi angles exist per restype (+UNK row)."""
+    mask = np.zeros((restype_num + 1, 4), np.float32)
+    for i, r in enumerate(restypes):
+        mask[i, : len(chi_angles_atoms[restype_1to3[r]])] = 1.0
+    return mask
+
+
+# list-of-lists view matching the reference's `chi_angles_mask` (20 rows)
+chi_angles_mask = [list(row) for row in chi_angles_mask_array()[:restype_num]]
+
+
+@functools.cache
+def chi_pi_periodic_array() -> np.ndarray:
+    """[21, 4] float32: 1 where the chi angle is pi-periodic (+UNK row)."""
+    out = np.zeros((restype_num + 1, 4), np.float32)
+    for i, r in enumerate(restypes):
+        for k in range(4):
+            if (restype_1to3[r], k) in _PI_PERIODIC:
+                out[i, k] = 1.0
+    return out
+
+
+chi_pi_periodic = [list(row) for row in chi_pi_periodic_array()[:restype_num]]
+
+
+@functools.cache
+def chi_atom_indices_array() -> np.ndarray:
+    """[21, 4, 4] int32 atom37 indices of each chi angle's 4 atoms (zeros
+    where undefined; +UNK row) — the reference builds this at call time
+    (all_atom.py get_chi_atom_indices)."""
+    out = np.zeros((restype_num + 1, 4, 4), np.int32)
+    for i, r in enumerate(restypes):
+        for k, atoms in enumerate(chi_angles_atoms[restype_1to3[r]]):
+            out[i, k] = [atom_order[a] for a in atoms]
+    return out

@@ -1,0 +1,114 @@
+"""ProteinFoldingModule — trains the folding trunk with BERT-style heads.
+
+The reference ships the trunk + parallelism pieces and points at the
+downstream HelixFold app for the full training recipe
+(/root/reference/projects/protein_folding/README.md:1-7); this module gives
+the trunk a runnable pretraining objective inside the framework: a
+masked-MSA head on the trunk's MSA output (AlphaFold Suppl. Alg. 2 line 20
+MaskedMsaHead, the trunk-only loss that needs no structure module) plus a
+distogram head on the pair output (Suppl. 1.9.8), so configs can exercise
+the full DistEmbeddingsAndEvoformer under the Trainer/DAP machinery.
+
+Batch contract (jnp arrays, see tests/test_folding_trunk.py _trunk_batch):
+  target_feat, msa_feat, seq_mask, msa_mask, aatype, residue_index,
+  extra_msa*, optional template_*/prev_*, plus for the losses:
+  bert_mask [B, S, R], true_msa [B, S, R] and (optional)
+  pseudo_beta [B, R, 3] / pseudo_beta_mask [B, R].
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fleetx_tpu.models import register_module
+from fleetx_tpu.models.language_module import resolve_compute_dtype
+from fleetx_tpu.models.module import BasicModule
+from fleetx_tpu.models.protein.folding import (
+    DistEmbeddingsAndEvoformer,
+    FoldingConfig,
+)
+from fleetx_tpu.models.protein.template import dgram_from_positions
+
+__all__ = ["ProteinFoldingModule"]
+
+
+class _TrunkWithHeads(nn.Module):
+    cfg: FoldingConfig
+    msa_classes: int = 23
+    distogram_bins: int = 64
+
+    @nn.compact
+    def __call__(self, batch):
+        out = DistEmbeddingsAndEvoformer(self.cfg, name="evoformer")(batch)
+        out["msa_logits"] = nn.Dense(
+            self.msa_classes, param_dtype=jnp.float32, dtype=jnp.float32,
+            name="masked_msa_head",
+        )(out["msa"].astype(jnp.float32))
+        pair = out["pair"].astype(jnp.float32)
+        half_logits = nn.Dense(
+            self.distogram_bins, param_dtype=jnp.float32, dtype=jnp.float32,
+            name="distogram_head",
+        )(pair)
+        # symmetrize (distances are symmetric)
+        out["distogram_logits"] = half_logits + jnp.swapaxes(half_logits, -2, -3)
+        return out
+
+
+@register_module("ProteinFoldingModule")
+class ProteinFoldingModule(BasicModule):
+    def get_model(self):
+        model_cfg = self.cfg.Model
+        eng = getattr(self.cfg, "Engine", None) or {}
+        dtype = resolve_compute_dtype(eng)
+        fc = FoldingConfig.from_model_config({**dict(model_cfg), "dtype": dtype})
+        self.folding_cfg = fc
+        self.dist_min = float(model_cfg.get("distogram_min_bin") or 2.3125)
+        self.dist_max = float(model_cfg.get("distogram_max_bin") or 21.6875)
+        self.dist_bins = int(model_cfg.get("distogram_num_bins") or 64)
+        return _TrunkWithHeads(fc, distogram_bins=self.dist_bins)
+
+    def init_params(self, rng, batch):
+        return self.nets.init(rng, self._jnp(batch))
+
+    @staticmethod
+    def _jnp(batch) -> Dict[str, jnp.ndarray]:
+        return {k: jnp.asarray(v) for k, v in batch.items()}
+
+    def loss_fn(self, params, batch, rng, train: bool):
+        del rng, train
+        out = self.nets.apply({"params": params["params"]}
+                              if "params" in params else {"params": params},
+                              self._jnp(batch))
+        metrics: Dict[str, jnp.ndarray] = {}
+        loss = jnp.float32(0.0)
+
+        bert_mask = batch.get("bert_mask")
+        if bert_mask is not None:
+            logp = jax.nn.log_softmax(out["msa_logits"], axis=-1)
+            true_msa = batch["true_msa"].astype(jnp.int32)
+            ll = jnp.take_along_axis(logp, true_msa[..., None], axis=-1)[..., 0]
+            m = bert_mask.astype(jnp.float32)
+            msa_loss = -jnp.sum(ll * m) / (jnp.sum(m) + 1e-8)
+            metrics["masked_msa_loss"] = msa_loss
+            loss = loss + msa_loss
+
+        pb = batch.get("pseudo_beta")
+        if pb is not None:
+            dgram = dgram_from_positions(
+                pb, num_bins=self.dist_bins, min_bin=self.dist_min,
+                max_bin=self.dist_max,
+            )  # one-hot target bins [B, R, R, bins]
+            logp = jax.nn.log_softmax(out["distogram_logits"], axis=-1)
+            pbm = batch.get("pseudo_beta_mask")
+            m2d = (pbm[..., :, None] * pbm[..., None, :]
+                   if pbm is not None else jnp.ones(logp.shape[:-1]))
+            ll = jnp.sum(logp * dgram, axis=-1)
+            dist_loss = -jnp.sum(ll * m2d) / (jnp.sum(m2d) + 1e-8)
+            metrics["distogram_loss"] = dist_loss
+            loss = loss + dist_loss
+
+        return loss, metrics
