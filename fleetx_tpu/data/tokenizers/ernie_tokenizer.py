@@ -1,0 +1,134 @@
+"""ERNIE/BERT-style WordPiece tokenizer.
+
+Capability parity with the tokenizer the reference's ERNIE preprocessing
+drives (/root/reference/ppfleetx/data/data_tools/ernie/preprocess/
+create_pretraining_data.py uses paddlenlp's ErnieTokenizer): standard
+basic-tokenization (lowercase, punctuation/CJK splitting) + greedy
+longest-match WordPiece over a ``vocab.txt``. Pure Python, zero-egress:
+``from_pretrained`` reads a local vocab file.
+"""
+
+from __future__ import annotations
+
+import os
+import unicodedata
+from typing import Dict, List, Optional
+
+__all__ = ["ErnieTokenizer"]
+
+
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(cp: int) -> bool:
+    return (
+        0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+        or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+        or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F
+    )
+
+
+class ErnieTokenizer:
+    cls_token = "[CLS]"
+    sep_token = "[SEP]"
+    mask_token = "[MASK]"
+    pad_token = "[PAD]"
+    unk_token = "[UNK]"
+
+    def __init__(self, vocab_file: str, do_lower_case: bool = True,
+                 max_chars_per_word: int = 100):
+        self.vocab: Dict[str, int] = {}
+        with open(vocab_file, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                tok = line.rstrip("\n")
+                if tok:
+                    self.vocab.setdefault(tok, i)
+        self.inv_vocab = {v: k for k, v in self.vocab.items()}
+        self.do_lower_case = do_lower_case
+        self.max_chars_per_word = max_chars_per_word
+        self.cls_token_id = self.vocab.get(self.cls_token, 1)
+        self.sep_token_id = self.vocab.get(self.sep_token, 2)
+        self.mask_token_id = self.vocab.get(self.mask_token, 3)
+        self.pad_token_id = self.vocab.get(self.pad_token, 0)
+        self.unk_token_id = self.vocab.get(self.unk_token, 0)
+
+    @classmethod
+    def from_pretrained(cls, path: Optional[str] = None) -> "ErnieTokenizer":
+        path = path or os.environ.get("FLEETX_VOCAB_DIR", ".")
+        vocab = path if path.endswith(".txt") else os.path.join(path, "vocab.txt")
+        return cls(vocab)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    # -------------------------------------------------------------- basic
+    def _basic_tokenize(self, text: str) -> List[str]:
+        if self.do_lower_case:
+            text = text.lower()
+        text = unicodedata.normalize("NFC", text)
+        out: List[str] = []
+        word: List[str] = []
+
+        def flush():
+            if word:
+                out.append("".join(word))
+                word.clear()
+
+        for ch in text:
+            cp = ord(ch)
+            if ch.isspace():
+                flush()
+            elif _is_cjk(cp) or _is_punctuation(ch):
+                flush()
+                out.append(ch)
+            elif unicodedata.category(ch) in ("Mn", "Cf") or cp == 0:
+                continue  # strip accents-in-progress / control chars
+            else:
+                word.append(ch)
+        flush()
+        return out
+
+    # ---------------------------------------------------------- wordpiece
+    def _wordpiece(self, word: str) -> List[str]:
+        if len(word) > self.max_chars_per_word:
+            return [self.unk_token]
+        pieces: List[str] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    piece = sub
+                    break
+                end -= 1
+            if piece is None:
+                return [self.unk_token]
+            pieces.append(piece)
+            start = end
+        return pieces
+
+    def tokenize(self, text: str) -> List[str]:
+        out: List[str] = []
+        for word in self._basic_tokenize(text):
+            out.extend(self._wordpiece(word))
+        return out
+
+    def convert_tokens_to_ids(self, tokens: List[str]) -> List[int]:
+        return [self.vocab.get(t, self.unk_token_id) for t in tokens]
+
+    def encode(self, text: str) -> List[int]:
+        return self.convert_tokens_to_ids(self.tokenize(text))
+
+    def decode(self, ids) -> str:
+        toks = [self.inv_vocab.get(int(i), self.unk_token) for i in ids]
+        text = " ".join(toks).replace(" ##", "")
+        return text

@@ -1,0 +1,66 @@
+"""ERNIE pretraining corpus builder: segmented jsonl -> mmap token dataset.
+
+Capability parity with the reference
+(/root/reference/ppfleetx/data/data_tools/ernie/preprocess/
+create_pretraining_data.py:1-416): WordPiece-tokenize each document with
+ErnieTokenizer, one index entry per document (matching the reference's doc-level
+instance building — ErnieDataset halves one entry into the SOP segment
+pair, so entries must span multiple sentences; pass ``--split-sentences``
+only for corpora whose "documents" are already multi-sentence lines),
+writing ``{prefix}_ids.npy`` + ``{prefix}_idx.npz``.
+The masking itself is *dynamic* in this framework — ErnieDataset re-draws
+span masks per (seed, epoch, index) at load time (fleetx_tpu/data/
+ernie_dataset.py), so the offline stage stores plain token ids instead of
+the reference's pre-baked masked instances; that is what makes multi-epoch
+training see fresh masks for free.
+
+    python tools/ernie/create_pretraining_data.py --input-path zh_seg.jsonl \
+        --output-prefix out/ernie --vocab-dir vocabs/ernie
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "../.."))
+
+
+def get_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--input-path", "--input_path", dest="input_path",
+                   required=True)
+    p.add_argument("--output-prefix", "--output_prefix", dest="output_prefix",
+                   required=True)
+    p.add_argument("--vocab-dir", "--model_name", dest="vocab_dir",
+                   default=None, help="directory holding vocab.txt")
+    p.add_argument("--json-key", "--json_key", dest="json_key", default="text")
+    p.add_argument("--split-sentences", action="store_true",
+                   help="one index entry per newline-split sentence instead "
+                        "of per document (degrades SOP pairing; see module "
+                        "docstring)")
+    p.add_argument("--workers", type=int, default=1)
+    return p.parse_args(argv)
+
+
+def run(args) -> dict:
+    from tools import preprocess_data as pp
+
+    pp_args = pp.get_args([
+        "--input", args.input_path,
+        "--output-prefix", args.output_prefix,
+        "--tokenizer-name", "ErnieTokenizer",
+        "--json-key", args.json_key,
+        "--workers", str(args.workers),
+    ] + ([] if args.vocab_dir is None else ["--vocab-dir", args.vocab_dir])
+      + (["--split-sentences"] if args.split_sentences else []))
+    return pp.run(pp_args)
+
+
+def main(argv=None):
+    run(get_args(argv))
+
+
+if __name__ == "__main__":
+    main()

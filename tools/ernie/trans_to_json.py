@@ -1,0 +1,14 @@
+"""ERNIE raw-text -> jsonl stage (reference
+/root/reference/ppfleetx/data/data_tools/ernie/preprocess/trans_to_json.py:
+same job as the GPT stage, kept as a separate entry point for CLI parity).
+Delegates to tools/raw_trans_to_json.py."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "../.."))
+
+from tools.raw_trans_to_json import get_args, main, run  # noqa: F401
+
+if __name__ == "__main__":
+    main()

@@ -1,73 +1,233 @@
-"""Offline corpus preprocessing: jsonl -> {prefix}_ids.npy + {prefix}_idx.npz
-(reference /root/reference/ppfleetx/data/data_tools/gpt/preprocess_data.py,
-same output format so corpora interchange with the reference).
+"""Offline corpus preprocessing: raw jsonl corpora -> mmap token datasets.
 
-    python tools/preprocess_data.py --input data.jsonl --output-prefix my_corpus \
-        --vocab-dir /path/with/vocab.json+merges.txt [--json-key text] [--workers N]
+Capability parity with the reference's multiprocess pipeline
+(/root/reference/ppfleetx/data/data_tools/gpt/preprocess_data.py:1-409):
+multiprocess tokenization with per-worker tokenizer init, directory walks
+over .jsonl/.jsonl.zst shards, optional sentence splitting, document EOS
+appending, dtype-narrowed output (uint16 when the vocab fits), and
+throughput logging — emitting ``{prefix}_ids.npy`` + ``{prefix}_idx.npz``
+(key ``lens``), the format GPTDataset/ErnieDataset mmap
+(fleetx_tpu/data/gpt_dataset.py:71-107). Token ids accumulate in bounded
+chunks, so corpora far larger than RAM stream through.
+
+Examples:
+    python tools/preprocess_data.py --input corpus/ --output-prefix out/gpt \
+        --tokenizer-name GPTTokenizer --vocab-dir vocabs/gpt2 --append-eos \
+        --workers 8
+    python tools/preprocess_data.py --input zh.jsonl --output-prefix out/ernie \
+        --tokenizer-name ErnieTokenizer --vocab-dir vocabs/ernie \
+        --split-sentences
 """
 
+from __future__ import annotations
+
 import argparse
+import io
 import json
 import multiprocessing as mp
 import os
 import sys
+import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
-from fleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
+from fleetx_tpu.utils.log import logger
 
-_tok = None
+TOKENIZERS = ("GPTTokenizer", "ErnieTokenizer")
+
+_worker = {}
 
 
-def _init(vocab_dir):
-    global _tok
-    _tok = GPTTokenizer.from_pretrained(vocab_dir)
+def _make_tokenizer(name, vocab_dir):
+    if name == "GPTTokenizer":
+        from fleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
+
+        return GPTTokenizer.from_pretrained(vocab_dir)
+    if name == "ErnieTokenizer":
+        from fleetx_tpu.data.tokenizers.ernie_tokenizer import ErnieTokenizer
+
+        return ErnieTokenizer.from_pretrained(vocab_dir)
+    raise ValueError(f"unknown tokenizer {name!r}; choose from {TOKENIZERS}")
+
+
+def _init_worker(args):
+    _worker["tok"] = _make_tokenizer(args.tokenizer_name, args.vocab_dir)
+    _worker["args"] = args
+
+
+def _split_sentences(text, args):
+    if not args.split_sentences:
+        return [text]
+    # newline-based splitting (the reference uses nltk punkt for English and
+    # newlines for Chinese; nltk models are unavailable offline, newline
+    # splitting covers the common pre-segmented corpora)
+    return [s for s in text.split("\n") if s.strip()]
 
 
 def _encode(line):
+    """jsonl line -> (list of sentence id-lists, utf8 bytes processed)."""
+    args = _worker["args"]
+    tok = _worker["tok"]
     try:
-        text = json.loads(line)[_encode.key]
-    except (json.JSONDecodeError, KeyError):
-        return None
-    ids = _tok.encode(text)
-    if not ids:
-        return None
-    ids.append(_tok.eos_token_id)
-    return np.asarray(ids, np.int32)
+        text = json.loads(line)[args.json_key]
+    except (json.JSONDecodeError, KeyError, TypeError):
+        return [], len(line.encode("utf-8", "ignore"))
+    if not isinstance(text, str):
+        # null / numeric json values: skip the record, don't kill the run
+        return [], len(line.encode("utf-8", "ignore"))
+    doc = []
+    for sentence in _split_sentences(text, args):
+        ids = tok.encode(sentence.strip())
+        if ids:
+            doc.append(ids)
+    if doc and args.append_eos:
+        eos = getattr(tok, "eos_token_id", None)
+        if eos is None:
+            eos = tok.sep_token_id
+        doc[-1] = doc[-1] + [eos]
+    return doc, len(text.encode("utf-8", "ignore"))
 
 
-def main():
+def _iter_lines(path):
+    """Yield text lines from a .jsonl or .jsonl.zst shard."""
+    if path.endswith(".zst"):
+        try:
+            import zstandard
+        except ImportError:
+            # silently dropping shards would corrupt the corpus composition
+            raise SystemExit(
+                f"{path} is zstd-compressed but the zstandard package is not "
+                "installed; decompress the shards or install zstandard")
+        with open(path, "rb") as fh:
+            reader = io.TextIOWrapper(
+                io.BufferedReader(zstandard.ZstdDecompressor().stream_reader(fh)),
+                encoding="utf-8",
+            )
+            yield from reader
+    else:
+        with open(path, encoding="utf-8") as f:
+            yield from f
+
+
+def collect_input_files(input_path):
+    if os.path.isfile(input_path):
+        return [input_path]
+    files = []
+    for root, _, fs in os.walk(input_path):
+        for f in fs:
+            if f.endswith((".jsonl", ".json", ".zst")):
+                files.append(os.path.join(root, f))
+    return sorted(files)
+
+
+def get_args(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("--input", required=True)
-    p.add_argument("--output-prefix", required=True)
-    p.add_argument("--json-key", default="text")
-    p.add_argument("--vocab-dir", default=None)
+    p.add_argument("--input", "--input_path", dest="input", required=True,
+                   help="jsonl file or directory of .jsonl/.jsonl.zst shards")
+    p.add_argument("--output-prefix", "--output_prefix", dest="output_prefix",
+                   required=True)
+    p.add_argument("--tokenizer-name", "--tokenizer_name",
+                   dest="tokenizer_name", default="GPTTokenizer",
+                   choices=TOKENIZERS)
+    p.add_argument("--vocab-dir", "--model_name", dest="vocab_dir",
+                   default=None,
+                   help="directory with vocab.json+merges.txt (GPT) or "
+                        "vocab.txt (ERNIE)")
+    p.add_argument("--json-key", "--json_key", dest="json_key", default="text")
+    p.add_argument("--split-sentences", "--split_sentences",
+                   dest="split_sentences", action="store_true",
+                   help="one index entry per sentence instead of per document")
+    p.add_argument("--append-eos", "--append_eos", dest="append_eos",
+                   action="store_true")
     p.add_argument("--workers", type=int, default=1)
-    args = p.parse_args()
+    p.add_argument("--log-interval", "--log_interval", dest="log_interval",
+                   type=int, default=10000)
+    return p.parse_args(argv)
 
-    _encode.key = args.json_key
-    docs, lens = [], []
-    with open(args.input, encoding="utf-8") as f:
-        if args.workers > 1:
-            with mp.Pool(args.workers, initializer=_init, initargs=(args.vocab_dir,)) as pool:
-                for ids in pool.imap(_encode, f, chunksize=64):
-                    if ids is not None:
-                        docs.append(ids)
-                        lens.append(len(ids))
-        else:
-            _init(args.vocab_dir)
-            for line in f:
-                ids = _encode(line)
-                if ids is not None:
-                    docs.append(ids)
-                    lens.append(len(ids))
 
-    all_ids = np.concatenate(docs) if docs else np.zeros(0, np.int32)
+def run(args) -> dict:
+    files = collect_input_files(args.input)
+    if not files:
+        raise SystemExit(f"no input files found under {args.input}")
+
+    # dtype narrows to uint16 when every token id fits (reference
+    # preprocess_data.py:316-320)
+    probe_tok = _make_tokenizer(args.tokenizer_name, args.vocab_dir)
+    save_dtype = np.uint16 if probe_tok.vocab_size < 2**16 - 1 else np.int32
+
+    chunks = []  # bounded id buffers (flushed np arrays)
+    current = []
+    lens = []
+    n_docs = n_sents = total_tokens = 0
+    total_bytes = 0
+    t0 = time.time()
+
+    def flush_current():
+        nonlocal current
+        if current:
+            chunks.append(np.asarray(current, dtype=save_dtype))
+            current = []
+
+    def consume(doc):
+        nonlocal n_docs, n_sents, total_tokens
+        if not doc:
+            return
+        n_docs += 1
+        for sent in doc:
+            lens.append(len(sent))
+            current.extend(sent)
+            n_sents += 1
+            total_tokens += len(sent)
+        if len(current) > 4_000_000:
+            flush_current()
+
+    step = 0
+    pool = None
+    if args.workers > 1:
+        pool = mp.Pool(args.workers, initializer=_init_worker, initargs=(args,))
+    else:
+        _init_worker(args)
+    try:
+        for path in files:
+            lines = _iter_lines(path)
+            encoded = (pool.imap(_encode, lines, 64) if pool
+                       else map(_encode, lines))
+            for doc, nbytes in encoded:
+                step += 1
+                total_bytes += nbytes
+                consume(doc)
+                if step % args.log_interval == 0:
+                    mbs = total_bytes / 1e6 / max(time.time() - t0, 1e-9)
+                    logger.info(
+                        "processed %d docs (%.1f MB/s), %d tokens",
+                        step, mbs, total_tokens,
+                    )
+    finally:
+        if pool is not None:
+            pool.close()
+            pool.join()
+    flush_current()
+
+    all_ids = (np.concatenate(chunks) if chunks
+               else np.zeros(0, dtype=save_dtype))
+    out_dir = os.path.dirname(os.path.abspath(args.output_prefix))
+    os.makedirs(out_dir, exist_ok=True)
     np.save(args.output_prefix + "_ids.npy", all_ids)
-    np.savez(args.output_prefix + "_idx.npz", lens=np.asarray(lens, np.int32))
-    print(f"wrote {len(docs)} docs, {len(all_ids)} tokens -> {args.output_prefix}_(ids.npy|idx.npz)")
+    np.savez(args.output_prefix + "_idx.npz",
+             lens=np.asarray(lens, np.int32))
+    stats = {
+        "files": len(files), "docs": n_docs, "sentences": n_sents,
+        "tokens": int(total_tokens), "dtype": str(np.dtype(save_dtype)),
+        "elapsed_s": round(time.time() - t0, 2),
+    }
+    logger.info("wrote %s_(ids.npy|idx.npz): %s", args.output_prefix, stats)
+    return stats
+
+
+def main(argv=None):
+    run(get_args(argv))
 
 
 if __name__ == "__main__":

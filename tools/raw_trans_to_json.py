@@ -1,0 +1,109 @@
+"""Raw text corpora -> jsonl (one document per line).
+
+Capability parity with the reference's first preprocessing stage
+(/root/reference/ppfleetx/data/data_tools/gpt/raw_trans_to_json.py:1-179):
+walk an input directory of plain-text files, split documents on a
+configurable separator line (blank line by default), drop too-short
+documents, and write ``{"text": ...}`` jsonl shards that
+tools/preprocess_data.py tokenizes. Multiprocess over input files.
+
+    python tools/raw_trans_to_json.py --input-path raw/ --output-path corpus \
+        [--doc-spliter ""] [--min-doc-length 10] [--workers N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from fleetx_tpu.utils.log import logger
+
+
+def get_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--input-path", "--input_path", dest="input_path",
+                   required=True, help="file or directory of raw .txt files")
+    p.add_argument("--output-path", "--output_path", dest="output_path",
+                   required=True, help="output prefix; writes {prefix}.jsonl")
+    p.add_argument("--json-key", "--json_key", dest="json_key", default="text")
+    p.add_argument("--doc-spliter", "--doc_spliter", dest="doc_spliter",
+                   default="", help="separator line between documents "
+                   "(stripped); empty = blank line")
+    p.add_argument("--min-doc-length", "--min_doc_length",
+                   dest="min_doc_length", type=int, default=10)
+    p.add_argument("--all-files", action="store_true",
+                   help="ingest every file in the walk, not just .txt/.text")
+    p.add_argument("--workers", type=int, default=1)
+    return p.parse_args(argv)
+
+
+def raw_text_to_docs(path, doc_spliter="", min_doc_length=10):
+    """One text file -> list of documents (strings)."""
+    docs = []
+    doc_lines = []
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            if line.strip() == doc_spliter:
+                doc = "\n".join(doc_lines).strip()
+                if len(doc) > min_doc_length:
+                    docs.append(doc)
+                doc_lines = []
+            else:
+                doc_lines.append(line.rstrip("\n"))
+    doc = "\n".join(doc_lines).strip()
+    if len(doc) > min_doc_length:
+        docs.append(doc)
+    return docs
+
+
+def _process_file(task):
+    path, args = task
+    docs = raw_text_to_docs(path, args.doc_spliter, args.min_doc_length)
+    return [json.dumps({args.json_key: d}, ensure_ascii=False) for d in docs]
+
+
+def run(args) -> dict:
+    if os.path.isfile(args.input_path):
+        files = [args.input_path]
+    else:
+        exts = None if args.all_files else (".txt", ".text")
+        files = sorted(
+            os.path.join(root, f)
+            for root, _, fs in os.walk(args.input_path)
+            for f in fs
+            if exts is None or f.endswith(exts)
+        )
+    if not files:
+        raise SystemExit(f"no input files under {args.input_path}")
+    out_path = args.output_path + ".jsonl"
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    n_docs = 0
+    tasks = [(f, args) for f in files]
+    with open(out_path, "w", encoding="utf-8") as out:
+        if args.workers > 1:
+            with mp.Pool(args.workers) as pool:
+                for lines in pool.imap(_process_file, tasks):
+                    for line in lines:
+                        out.write(line + "\n")
+                    n_docs += len(lines)
+        else:
+            for task in tasks:
+                lines = _process_file(task)
+                for line in lines:
+                    out.write(line + "\n")
+                n_docs += len(lines)
+    logger.info("wrote %d docs from %d files -> %s", n_docs, len(files), out_path)
+    return {"files": len(files), "docs": n_docs, "output": out_path}
+
+
+def main(argv=None):
+    run(get_args(argv))
+
+
+if __name__ == "__main__":
+    main()
