@@ -166,7 +166,10 @@ class Trainer:
 
         self.root_key = dist_env.set_seed(glb.seed)
         self.lr_schedule = build_lr_scheduler((cfg.Optimizer or {}).get("lr", 1e-4))
-        self.tx = build_optimizer(cfg.Optimizer or {}, self.lr_schedule)
+        self.tx = build_optimizer(
+            cfg.Optimizer or {}, self.lr_schedule,
+            weight_decay_mask=module.weight_decay_mask(),
+        )
 
         self._compiled = {}
         self.state: Optional[TrainState] = None
@@ -208,6 +211,11 @@ class Trainer:
             dict(self.mesh.shape),
         )
         self.n_params = n_params
+        loaded = self.module.load_pretrained(_unbox(self.state.params))
+        if loaded is not None:
+            boxed = _rebox_like(loaded, self.state.params)
+            boxed = jax.device_put(boxed, self._state_sharding_tree.params)
+            self.state = self.state.replace(params=boxed)
         return self.state
 
     @staticmethod

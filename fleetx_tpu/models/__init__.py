@@ -42,6 +42,7 @@ def _populate():
         ("MoEModule", "fleetx_tpu.models.moe_module", "MoEModule"),
         ("GeneralClsModule", "fleetx_tpu.models.vision_module", "GeneralClsModule"),
         ("MOCOModule", "fleetx_tpu.models.moco_module", "MOCOModule"),
+        ("MOCOClsModule", "fleetx_tpu.models.moco_module", "MOCOClsModule"),
         ("ErnieModule", "fleetx_tpu.models.ernie_module", "ErnieModule"),
         ("ImagenModule", "fleetx_tpu.models.imagen_module", "ImagenModule"),
         ("ProteinFoldingModule", "fleetx_tpu.models.protein_module", "ProteinFoldingModule"),

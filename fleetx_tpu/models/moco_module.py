@@ -30,7 +30,7 @@ from fleetx_tpu.models.vision.resnet import build_resnet
 from fleetx_tpu.models.vision.vit import ViTConfig, ViT
 from fleetx_tpu.utils.log import logger
 
-__all__ = ["MOCOModule"]
+__all__ = ["MOCOModule", "MOCOClsModule"]
 
 
 class MOCOModule(BasicModule):
@@ -150,6 +150,126 @@ class MOCOModule(BasicModule):
             "query": jax.ShapeDtypeStruct((b, size, size, 3), jnp.float32),
             "key": jax.ShapeDtypeStruct((b, size, size, 3), jnp.float32),
         }
+
+    def training_step_end(self, log: Dict) -> None:
+        from fleetx_tpu.models.vision_module import log_images_per_sec
+
+        log_images_per_sec(self.cfg, log)
+
+
+class MOCOClsModule(BasicModule):
+    """Linear-probe classification on a frozen MoCo backbone (reference
+    MOCOClsModule, /root/reference/ppfleetx/models/vision_model/
+    moco_module.py: backbone frozen, only the linear head trains).
+
+    Batch contract: {"images": [b,H,W,C], "labels": [b]}. Backbone params
+    restore from a MoCo pretraining checkpoint; gradients stop at the
+    feature boundary, so the optimizer only moves the head (frozen backbone
+    weights receive zero gradient)."""
+
+    def get_model(self):
+        import flax.linen as nn
+
+        model_cfg = self.cfg.Model if hasattr(self.cfg, "Model") else self.cfg
+        eng = getattr(self.cfg, "Engine", None) or {}
+        dtype = resolve_compute_dtype(eng)
+        backbone = str(model_cfg.get("backbone") or "resnet50")
+        num_classes = int(model_cfg.get("num_classes") or 1000)
+        resnet_kw = {}
+        if model_cfg.get("width"):
+            resnet_kw["width"] = int(model_cfg["width"])
+
+        class LinearProbe(nn.Module):
+            @nn.compact
+            def __call__(self, images):
+                h = build_resnet(backbone, num_classes=0, dtype=dtype,
+                                 **resnet_kw)(images)
+                h = jax.lax.stop_gradient(h.astype(jnp.float32))
+                return nn.Dense(num_classes, name="cls_head")(h)
+
+        return LinearProbe()
+
+    def init_params(self, rng, batch):
+        return self.nets.init(rng, jnp.asarray(batch["images"]))
+
+    def load_pretrained(self, params):
+        """Copy the frozen backbone from a MoCo pretraining artifact
+        (Model.pretrained: an orbax params dir, or an export dir holding
+        one under 'params'). Leaves whose path+shape match transfer; the
+        fresh cls_head stays; zero backbone matches is an error — silently
+        probing random features is the failure mode this guards."""
+        import os
+
+        model_cfg = self.cfg.Model if hasattr(self.cfg, "Model") else self.cfg
+        src_dir = model_cfg.get("pretrained")
+        if not src_dir:
+            logger.warning(
+                "MOCOClsModule without Model.pretrained: the linear probe "
+                "will run on a RANDOM frozen backbone"
+            )
+            return None
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(src_dir)
+        if os.path.isdir(os.path.join(path, "params")):
+            path = os.path.join(path, "params")
+        source = ocp.StandardCheckpointer().restore(path)
+        if isinstance(source, dict) and "params" in source:
+            source = source["params"]
+
+        flat_src = {
+            tuple(str(getattr(k, "key", k)) for k in p): v
+            for p, v in jax.tree_util.tree_flatten_with_path(source)[0]
+        }
+        hits = [0]
+
+        def take(pth, leaf):
+            key = tuple(str(getattr(k, "key", k)) for k in pth)
+            cand = flat_src.get(key)
+            if cand is not None and getattr(cand, "shape", None) == leaf.shape:
+                hits[0] += 1
+                return jnp.asarray(cand, leaf.dtype)
+            return leaf
+
+        out = jax.tree_util.tree_map_with_path(take, params)
+        if hits[0] == 0:
+            raise ValueError(
+                f"Model.pretrained={src_dir!r} shares no matching weights "
+                "with the linear-probe backbone — wrong checkpoint?"
+            )
+        logger.info("loaded %d pretrained backbone tensors from %s",
+                    hits[0], src_dir)
+        return out
+
+    def weight_decay_mask(self):
+        """Decay only the trainable head: stop_gradient freezes backbone
+        gradients but decoupled weight decay would still erode the frozen
+        backbone without this mask."""
+        def mask(params):
+            def is_head(path, leaf):
+                return any(
+                    str(getattr(k, "key", k)) == "cls_head" for k in path
+                )
+
+            return jax.tree_util.tree_map_with_path(is_head, params)
+
+        return mask
+
+    def loss_fn(self, params, batch, rng, train: bool):
+        del rng, train
+        logits = self.nets.apply({"params": params}, batch["images"])
+        labels = batch["labels"].astype(jnp.int32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        loss = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+        acc = (jnp.argmax(logits, axis=-1) == labels).mean()
+        return loss, {"acc": acc}
+
+    def input_spec(self):
+        glb = self.cfg.Global
+        model_cfg = self.cfg.Model
+        size = int(model_cfg.get("image_size") or 224)
+        b = glb.micro_batch_size or 1
+        return {"images": jax.ShapeDtypeStruct((b, size, size, 3), jnp.float32)}
 
     def training_step_end(self, log: Dict) -> None:
         from fleetx_tpu.models.vision_module import log_images_per_sec

@@ -19,7 +19,34 @@ class BasicModule:
 
     def __init__(self, cfg):
         self.cfg = cfg
+        # QAT (reference Quantization config section, qat_gpt_*.yaml +
+        # eager_engine.py:159-160 _quant_mode): when enabled, loss_fns run
+        # the forward on fake-quantized weights (STE gradients).
+        q = (cfg.get("Quantization") or {}) if hasattr(cfg, "get") else {}
+        self.quant_enabled = bool(q.get("enable"))
+        self.quant_bits = int(q.get("weight_bits") or 8)
         self.nets = self.get_model()
+
+    def maybe_fake_quant(self, params):
+        """Fake-quantize eligible weights for QAT; identity otherwise."""
+        if not self.quant_enabled:
+            return params
+        from fleetx_tpu.ops.quant import fake_quant_tree
+
+        return fake_quant_tree(params, bits=self.quant_bits)
+
+    def load_pretrained(self, params):
+        """Optionally map pretrained weights onto freshly initialized params
+        (called by the Trainer after init). Return the updated tree, or None
+        for no-op. Modules that finetune from a different architecture
+        (e.g. a linear probe on a MoCo encoder) override this."""
+        return None
+
+    def weight_decay_mask(self):
+        """Optional weight-decay mask fn(params)->bool tree for the
+        optimizer; None uses the standard no-norm/no-bias mask. Modules with
+        frozen subtrees override this so decay can't erode frozen weights."""
+        return None
 
     # --- construction -----------------------------------------------------
     def get_model(self):

@@ -73,8 +73,14 @@ def export_inference_model(
     output_dir: str,
     forward_fn=None,
     input_spec: Optional[Dict[str, jax.ShapeDtypeStruct]] = None,
+    quantize: Optional[str] = None,
 ) -> str:
-    """Write the export artifact for ``module`` with ``params``."""
+    """Write the export artifact for ``module`` with ``params``.
+
+    ``quantize="int8"`` stores weight-only per-channel int8 params (the
+    reference's quantized export, eager_engine.py:734-745 + paddleslim);
+    load_exported dequantizes transparently, so serving code is unchanged
+    while the artifact holds int8 weights + fp32 scales."""
     import orbax.checkpoint as ocp
 
     os.makedirs(output_dir, exist_ok=True)
@@ -91,16 +97,25 @@ def export_inference_model(
         # at inference in fp32 while the export traced bf16
         if k in ("Model", "Generation", "Global", "Data", "Engine")
     }
+    if quantize:
+        if quantize != "int8":
+            raise ValueError(f"unsupported quantize={quantize!r} (only 'int8')")
+        keep["Quantization"] = {"export": "int8_weight_only"}
     with open(os.path.join(output_dir, "config.yaml"), "w") as f:
         yaml.safe_dump(json.loads(json.dumps(keep)), f)
 
     # 2. params (unboxed; inference has no sharding metadata needs)
     from fleetx_tpu.core.engine import _unbox
 
+    save_params = _unbox(params)
+    if quantize:
+        from fleetx_tpu.ops.quant import quantize_tree_int8
+
+        save_params = jax.device_get(quantize_tree_int8(save_params))
     ckpter = ocp.StandardCheckpointer()
     ckpter.save(
         os.path.abspath(os.path.join(output_dir, "params")),
-        _unbox(params),
+        save_params,
         force=True,
     )
     ckpter.wait_until_finished()
@@ -119,7 +134,7 @@ def export_inference_model(
 
     abstract_params = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), _unbox(params)
-    )
+    )  # traced at full precision; int8 artifacts dequantize at load
     # input_spec.json records exactly the served keys (a finetune module's
     # training spec also lists labels, which serving never reads). A
     # serving_forward hook may return a full spec dict with extra inputs
@@ -151,4 +166,8 @@ def load_exported(export_dir: str):
         }
     ckpter = ocp.StandardCheckpointer()
     params = ckpter.restore(os.path.abspath(os.path.join(export_dir, "params")))
+    if (cfg.get("Quantization") or {}).get("export") == "int8_weight_only":
+        from fleetx_tpu.ops.quant import dequantize_tree_int8
+
+        params = dequantize_tree_int8(params, dtype=np.float32)
     return cfg, params, spec

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# ERNIE-175B-scale mp8xpp16 (reference projects/ernie/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/ernie/pretrain_ernie_base_175B_mp8_pp16.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# ERNIE-345M single-chip pretraining (reference projects/ernie/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/ernie/pretrain_ernie_base_345M_single_card.yaml "$@"

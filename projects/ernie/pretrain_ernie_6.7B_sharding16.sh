@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# ERNIE-6.7B 16-way sharding (reference projects/ernie/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/ernie/pretrain_ernie_base_6.7B_sharding16.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# ERNIE base 3D hybrid parallel dp2xmp2xpp2 (reference projects/ernie/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/ernie/pretrain_ernie_base_3D.yaml "$@"

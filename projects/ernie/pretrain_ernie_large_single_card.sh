@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# ERNIE-large pretraining (reference projects/ernie/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/ernie/pretrain_ernie_large_single_card.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Auto-parallel GPT-1.3B dp8 (reference projects/gpt/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/gpt/auto/pretrain_gpt_1.3B_dp8.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Auto-parallel GPT-345M (GSPMD is the one engine) (reference projects/gpt/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/gpt/auto/pretrain_gpt_345M_single_card.yaml "$@"

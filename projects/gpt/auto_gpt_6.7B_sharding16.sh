@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Auto-parallel GPT-6.7B sharding16 (reference projects/gpt/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/gpt/auto/pretrain_gpt_6.7B_sharding16.yaml "$@"

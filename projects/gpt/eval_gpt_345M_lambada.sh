@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Offline LAMBADA accuracy eval (reference projects/gpt/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/eval.py -c configs/nlp/gpt/eval_gpt_345M_single_card.yaml -o Offline_Eval.cloze_eval=True "$@"

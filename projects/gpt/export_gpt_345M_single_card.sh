@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Export GPT-345M to a serving artifact (reference projects/gpt/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/export.py -c configs/nlp/gpt/pretrain_gpt_345M_single_card.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# GPT-345M GLUE finetuning (reference projects/gpt/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/gpt/finetune_gpt_345M_single_card_glue.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# GPT-345M batch generation over dp8 (reference projects/gpt/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tasks/gpt/generation.py -c configs/nlp/gpt/generation_gpt_345M_dp8.yaml "$@"

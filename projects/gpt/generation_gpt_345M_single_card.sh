@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# GPT-345M text generation (reference projects/gpt/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tasks/gpt/generation.py -c configs/nlp/gpt/generation_gpt_345M_single_card.yaml "$@"

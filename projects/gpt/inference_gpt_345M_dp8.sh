@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Serve GPT-345M replicated over 8 chips (reference projects/gpt/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/inference.py -c configs/nlp/gpt/inference_gpt_345M_dp8.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Serve an exported GPT-345M (reference projects/gpt/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/inference.py -c configs/nlp/gpt/inference_gpt_345M_single_card.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# GPT-1.3B single-chip pretraining (reference projects/gpt/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/gpt/pretrain_gpt_1.3B_single_card.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Chinese GPT-345M pretraining (reference projects/gpt/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/gpt/pretrain_gpt_cn_345M_single_card.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# GPT-345M quantization-aware training over mp8 (reference projects/gpt/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/gpt/qat_gpt_345M_mp8.yaml "$@"

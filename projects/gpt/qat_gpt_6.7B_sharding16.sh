@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# GPT-6.7B QAT with 16-way sharding (reference projects/gpt/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/gpt/qat_gpt_6.7B_sharding16.yaml "$@"

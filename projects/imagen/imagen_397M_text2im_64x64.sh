@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Imagen 397M base stage pretraining (reference projects/imagen/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/multimodal/imagen/imagen_397M_text2im_64x64.yaml "$@"

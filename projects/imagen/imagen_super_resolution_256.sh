@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Imagen SR-256 stage pretraining (reference projects/imagen/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/multimodal/imagen/imagen_super_resolution_256.yaml "$@"

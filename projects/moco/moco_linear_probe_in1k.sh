@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Linear probe on a frozen MoCo backbone (reference projects/moco/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/vis/moco/moco_lincls_in1k_1n8c.yaml "$@"

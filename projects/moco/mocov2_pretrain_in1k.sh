@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# MoCo v2 ImageNet pretraining (reference projects/moco/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/vis/moco/mocov2_pt_in1k_1n8c.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# MoE GPT-1.3B with expert parallelism over dp8 (reference projects/moe/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/nlp/moe/pretrain_moe_1.3B_dp8.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Folding trunk pretraining with DAP over 8 chips (reference projects/protein_folding/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/protein/pretrain_folding_dap8.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Serve an exported ViT-B/16 classifier (reference projects/vit/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/inference.py -c configs/vis/vit/ViT_base_patch16_224_inference.yaml "$@"

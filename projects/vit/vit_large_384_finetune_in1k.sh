@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# ViT-L/16-384 ImageNet finetune (reference projects/vit/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/vis/vit/ViT_large_patch16_384_ft_in1k_2n16c_dp_fp16o2.yaml "$@"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# ViT-tiny CI smoke on CIFAR-10 (reference projects/vit/)
+set -eux
+cd "$(dirname "$0")/../.."
+python tools/train.py -c configs/vis/vit/ViT_tiny_patch16_224_ci_cifar10_1n8c_dp_fp16o2.yaml "$@"

@@ -3,6 +3,8 @@ MOCOModule training run through the extra-state Trainer path."""
 
 import textwrap
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -131,3 +133,82 @@ def test_moco_trains_with_fit(tmp_path, eight_devices):
     loader = build_dataloader(cfg, "Train")
     trainer.fit(loader)
     assert int(trainer.state.step) == 4
+
+
+def test_moco_lincls_loads_pretrained_backbone(tmp_path, eight_devices):
+    """MOCOClsModule maps the MoCo encoder backbone onto the linear probe
+    (frozen), errors on checkpoints with nothing to transfer, and its decay
+    mask covers only the head."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    from fleetx_tpu.models import build_module
+    from fleetx_tpu.utils.config import AttrDict, process_configs
+
+    # a tiny MoCo pretraining encoder -> params artifact
+    pre_cfg = AttrDict(
+        Global=AttrDict(seed=0, local_batch_size=2, micro_batch_size=2),
+        Engine=AttrDict(mix_precision=AttrDict(use_pure_fp16=False)),
+        Model=AttrDict(module="MOCOModule", backbone="resnet18", dim=16,
+                       queue_size=64, image_size=32, width=8),
+        Optimizer=AttrDict(name="Momentum", lr=AttrDict(
+            name="CosineDecay", learning_rate=0.03, decay_steps=10)),
+        Distributed=AttrDict(dp_degree=1),
+    )
+    process_configs(pre_cfg, nranks=1)
+    moco = build_module(pre_cfg)
+    batch = {"query": np.zeros((2, 32, 32, 3), np.float32),
+             "key": np.zeros((2, 32, 32, 3), np.float32)}
+    variables = moco.init_params(jax.random.PRNGKey(7), batch)
+    ck = ocp.StandardCheckpointer()
+    ck.save(str(tmp_path / "moco_params"), dict(variables["params"]), force=True)
+    ck.wait_until_finished()
+
+    cls_cfg = AttrDict(
+        Global=AttrDict(seed=0, local_batch_size=2, micro_batch_size=2),
+        Engine=AttrDict(mix_precision=AttrDict(use_pure_fp16=False)),
+        Model=AttrDict(module="MOCOClsModule", backbone="resnet18",
+                       num_classes=10, image_size=32, width=8,
+                       pretrained=str(tmp_path / "moco_params")),
+        Optimizer=AttrDict(name="Momentum", lr=AttrDict(
+            name="CosineDecay", learning_rate=30.0, decay_steps=10)),
+        Distributed=AttrDict(dp_degree=1),
+    )
+    process_configs(cls_cfg, nranks=1)
+    probe = build_module(cls_cfg)
+    init = probe.init_params(jax.random.PRNGKey(0),
+                             {"images": batch["query"]})["params"]
+    loaded = probe.load_pretrained(init)
+    assert loaded is not None
+    # the backbone subtree must now equal the MoCo encoder's
+    src_flat = {
+        tuple(str(getattr(k, "key", k)) for k in p): v
+        for p, v in jax.tree_util.tree_flatten_with_path(
+            dict(variables["params"]))[0]
+    }
+    moved = 0
+    for p, v in jax.tree_util.tree_flatten_with_path(loaded)[0]:
+        key = tuple(str(getattr(k, "key", k)) for k in p)
+        if key in src_flat:
+            np.testing.assert_array_equal(np.asarray(v),
+                                          np.asarray(src_flat[key]))
+            moved += 1
+    assert moved > 10  # the whole resnet transferred
+
+    # decay mask: True only under cls_head
+    mask = probe.weight_decay_mask()(loaded)
+    flat = jax.tree_util.tree_flatten_with_path(mask)[0]
+    heads = [v for p, v in flat
+             if any(str(getattr(k, "key", k)) == "cls_head" for k in p)]
+    others = [v for p, v in flat
+              if not any(str(getattr(k, "key", k)) == "cls_head" for k in p)]
+    assert all(heads) and not any(others)
+
+    # wrong checkpoint: nothing matches -> hard error
+    bogus_dir = tmp_path / "bogus"
+    ck.save(str(bogus_dir), {"something": np.zeros((3, 3), np.float32)},
+            force=True)
+    ck.wait_until_finished()
+    probe.cfg.Model.pretrained = str(bogus_dir)
+    with pytest.raises(ValueError, match="no matching weights"):
+        probe.load_pretrained(init)

@@ -1,0 +1,125 @@
+"""Quantization wiring: QAT fake-quant in the jitted loss trains with
+falling loss, int8 PTQ export round-trips with bounded logit drift, and the
+QAT config pair builds (VERDICT r2 item 6 done-criteria)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fleetx_tpu.utils.config import AttrDict, get_config, process_configs
+
+
+def test_fake_quant_ste_gradient():
+    from fleetx_tpu.ops.quant import fake_quant
+
+    w = jnp.asarray(np.random.RandomState(0).randn(8, 8), jnp.float32)
+    g = jax.grad(lambda w: (fake_quant(w) ** 2).sum())(w)
+    # straight-through: gradient == gradient of the *quantized* value wrt
+    # identity path = 2*deq; nonzero everywhere and close to 2*w
+    assert np.abs(np.asarray(g)).min() > 0
+    np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(fake_quant(w)),
+                               rtol=1e-5)
+
+
+def _tiny_qat_cfg(tmp_path, enable=True, dp=4, mp=2, nranks=8):
+    cfg = AttrDict(
+        Global=AttrDict(seed=0, local_batch_size=4, micro_batch_size=4),
+        Engine=AttrDict(
+            max_steps=12, logging_freq=100,
+            mix_precision=AttrDict(use_pure_fp16=False),
+            save_load=AttrDict(save_steps=10**9, output_dir=str(tmp_path)),
+        ),
+        Model=AttrDict(
+            module="GPTModule", vocab_size=64, hidden_size=32, num_layers=2,
+            num_attention_heads=2, ffn_hidden_size=64,
+            max_position_embeddings=16, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0, use_flash_attention=False,
+        ),
+        Optimizer=AttrDict(
+            name="AdamW", weight_decay=0.0,
+            lr=AttrDict(name="CosineDecay", learning_rate=3e-3, decay_steps=100),
+        ),
+        Distributed=AttrDict(dp_degree=dp, mp_degree=mp, pp_degree=1),
+        Quantization=AttrDict(enable=enable, weight_bits=8),
+    )
+    process_configs(cfg, nranks=nranks)
+    return cfg
+
+
+def test_qat_trains_with_falling_loss(tmp_path, eight_devices):
+    from fleetx_tpu.core.engine import Trainer
+    from fleetx_tpu.models import build_module
+    import fleetx_tpu.parallel.env as dist_env
+
+    cfg = _tiny_qat_cfg(tmp_path)
+    module = build_module(cfg)
+    assert module.quant_enabled
+    trainer = Trainer(cfg, module)
+    rng = np.random.RandomState(0)
+    tokens = ((np.arange(16)[None, :] + rng.randint(0, 64, (4, 1))) % 64)
+    batch = {
+        "tokens": tokens.astype(np.int32),
+        "labels": ((tokens + 1) % 64).astype(np.int32),
+        "loss_mask": np.ones((4, 16), np.float32),
+    }
+    trainer.init_state(batch)
+    step = trainer._get("train", trainer._build_train_step)
+    db = trainer._shard_batch(batch)
+    losses = []
+    state = trainer.state
+    for i in range(12):
+        state, m = step(state, db, dist_env.data_rank_key(i))
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.3, losses
+
+
+def test_int8_export_logit_drift(tmp_path, eight_devices):
+    from fleetx_tpu.core.inference_engine import InferenceEngine
+    from fleetx_tpu.models import build_module
+    from fleetx_tpu.utils.export import export_inference_model
+
+    cfg = _tiny_qat_cfg(tmp_path, enable=False, dp=1, mp=1, nranks=1)
+    cfg.Data = None
+    module = build_module(cfg)
+    rng = np.random.RandomState(0)
+    batch = {"tokens": rng.randint(0, 64, (2, 16)).astype(np.int32)}
+    variables = module.init_params(jax.random.PRNGKey(0), batch)
+    params = variables["params"]
+    spec = {"tokens": jax.ShapeDtypeStruct((2, 16), jnp.int32)}
+
+    fp_dir = str(tmp_path / "fp")
+    q_dir = str(tmp_path / "q8")
+    export_inference_model(module, params, fp_dir, input_spec=spec)
+    export_inference_model(module, params, q_dir, input_spec=spec,
+                           quantize="int8")
+
+    fp = InferenceEngine(fp_dir).predict(batch)
+    q8 = InferenceEngine(q_dir).predict(batch)
+    # per-channel absmax int8 weight-only: logits drift stays small relative
+    # to the logit scale
+    scale = np.abs(fp).max() + 1e-9
+    drift = np.abs(fp - q8).max() / scale
+    assert drift < 0.1, drift
+    assert drift > 0  # it IS quantized, not a copy
+
+    # the artifact really holds int8 weights
+    import orbax.checkpoint as ocp
+
+    raw = ocp.StandardCheckpointer().restore(
+        str(tmp_path / "q8" / "params"))
+    flat = jax.tree.leaves(raw)
+    assert any(getattr(x, "dtype", None) == np.int8 for x in flat)
+
+
+def test_qat_config_zoo_builds():
+    from fleetx_tpu.models import build_module
+
+    for name, nranks in [("qat_gpt_345M_mp8.yaml", 8),
+                         ("qat_gpt_6.7B_sharding16.yaml", 16)]:
+        cfg = get_config(f"configs/nlp/gpt/{name}", nranks=nranks)
+        assert cfg.Quantization.enable
+        module = build_module(cfg)
+        assert module.quant_enabled and module.quant_bits == 8

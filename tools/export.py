@@ -35,8 +35,13 @@ def main():
     if (cfg.Engine.save_load or {}).get("ckpt_dir"):
         trainer.load()
     out = (cfg.Engine.save_load or {}).get("output_dir") or "./exported"
-    export_inference_model(module, trainer.state.params, out, input_spec=spec)
-    logger.info("export done: %s", out)
+    # QAT configs export int8 weights (reference quantized export,
+    # eager_engine.py:734-745); serving dequantizes transparently
+    quantize = "int8" if (cfg.get("Quantization") or {}).get("enable") else None
+    export_inference_model(
+        module, trainer.state.params, out, input_spec=spec, quantize=quantize
+    )
+    logger.info("export done: %s%s", out, " (int8 weights)" if quantize else "")
 
 
 if __name__ == "__main__":

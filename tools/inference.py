@@ -18,14 +18,27 @@ from fleetx_tpu.utils.log import logger
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--export-dir", required=True)
+    ap.add_argument("--export-dir", default=None)
+    ap.add_argument("-c", "--config", default=None,
+                    help="inference yaml with Inference.model_dir "
+                         "(reference inference_gpt_*.yaml surface)")
+    ap.add_argument("-o", "--override", action="append", default=[])
     ap.add_argument("--prompt", default=None, help="text (needs vocab) or "
                     "comma-separated token ids")
     ap.add_argument("--vocab-dir", default=None)
     ap.add_argument("--max-length", type=int, default=None)
     args = ap.parse_args()
 
-    engine = InferenceEngine(args.export_dir)
+    export_dir = args.export_dir
+    if export_dir is None and args.config:
+        from fleetx_tpu.utils.config import get_config
+
+        cfg = get_config(args.config, overrides=args.override, show=False)
+        export_dir = (cfg.get("Inference") or {}).get("model_dir")
+    if not export_dir:
+        ap.error("--export-dir or -c config with Inference.model_dir required")
+
+    engine = InferenceEngine(export_dir)
     if args.prompt is None:
         logger.info("no --prompt; running a smoke forward")
         feed = {
