@@ -54,7 +54,11 @@ def make_grad_fn(module: "BasicModule", accum: int):
     reference's host-side micro-batch loop (eager_engine.py:442-483)."""
 
     def loss_for_micro(params, micro, rng):
-        loss, metrics = module.loss_fn(params, micro, rng, train=True)
+        # central QAT hook: STE fake-quant INSIDE the grad computation so
+        # every module family quantizes identically (no per-module wiring)
+        loss, metrics = module.loss_fn(
+            module.maybe_fake_quant(params), micro, rng, train=True
+        )
         return loss, metrics
 
     grad_fn = jax.value_and_grad(loss_for_micro, has_aux=True)
@@ -94,7 +98,9 @@ def make_grad_fn_extra(module: "BasicModule", accum: int):
         )
 
     def loss_for(params, extra, batch, rng):
-        loss, aux, new_extra = module.loss_fn_extra(params, extra, batch, rng, train=True)
+        loss, aux, new_extra = module.loss_fn_extra(
+            module.maybe_fake_quant(params), extra, batch, rng, train=True
+        )
         return loss, (aux, new_extra)
 
     grad_fn = jax.value_and_grad(loss_for, has_aux=True)
@@ -211,7 +217,15 @@ class Trainer:
             dict(self.mesh.shape),
         )
         self.n_params = n_params
-        loaded = self.module.load_pretrained(_unbox(self.state.params))
+        resumable = False
+        if os.path.isdir(os.path.join(self.output_dir, "checkpoints")):
+            resumable = self._ckpt_manager().latest_step() is not None
+        if resumable:
+            loaded = None  # load() will restore everything; re-reading the
+            # pretrained artifact would be wasted I/O (or a crash if it was
+            # cleaned up after the first run)
+        else:
+            loaded = self.module.load_pretrained(_unbox(self.state.params))
         if loaded is not None:
             boxed = _rebox_like(loaded, self.state.params)
             boxed = jax.device_put(boxed, self._state_sharding_tree.params)
@@ -354,12 +368,13 @@ class Trainer:
         module = self.module
 
         def eval_step(state: TrainState, batch):
+            params = module.maybe_fake_quant(state.params)
             if state.extra is not None:
                 loss, metrics, _ = module.loss_fn_extra(
-                    state.params, state.extra, batch, None, train=False
+                    params, state.extra, batch, None, train=False
                 )
             else:
-                loss, metrics = module.loss_fn(state.params, batch, None, train=False)
+                loss, metrics = module.loss_fn(params, batch, None, train=False)
             return {"loss": loss, **metrics}
 
         sh = self._state_sharding_tree

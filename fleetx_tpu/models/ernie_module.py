@@ -45,7 +45,6 @@ class ErnieModule(LanguageModule):
         )
 
     def loss_fn(self, params, batch, rng, train: bool):
-        params = self.maybe_fake_quant(params)
         mlm_logits, sop_logits = self.nets.apply(
             {"params": params},
             batch["input_ids"],

@@ -70,7 +70,6 @@ class ImagenModule(BasicModule):
         )
 
     def loss_fn(self, params, batch, rng, train: bool):
-        params = self.maybe_fake_quant(params)
         images = batch["images"]
         b = images.shape[0]
         if rng is None:

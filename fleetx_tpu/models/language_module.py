@@ -121,7 +121,6 @@ class GPTModule(LanguageModule):
 
     def loss_fn(self, params, batch, rng, train: bool):
         tokens, position_ids, labels, loss_mask = self.cp_prepare(batch)
-        params = self.maybe_fake_quant(params)
         logits = self.nets.apply(
             {"params": params},
             tokens,

@@ -66,7 +66,6 @@ class GPTFinetuneModule(LanguageModule):
         )
 
     def loss_fn(self, params, batch, rng, train: bool):
-        params = self.maybe_fake_quant(params)
         logits = self.nets.apply(
             {"params": params},
             batch["tokens"],

@@ -211,11 +211,24 @@ class MOCOClsModule(BasicModule):
         import orbax.checkpoint as ocp
 
         path = os.path.abspath(src_dir)
-        if os.path.isdir(os.path.join(path, "params")):
-            path = os.path.join(path, "params")
-        source = ocp.StandardCheckpointer().restore(path)
-        if isinstance(source, dict) and "params" in source:
-            source = source["params"]
+        if os.path.isdir(os.path.join(path, "checkpoints")):
+            path = os.path.join(path, "checkpoints")  # Trainer output_dir
+        step_dirs = [d for d in os.listdir(path) if d.isdigit()] \
+            if os.path.isdir(path) else []
+        if step_dirs:
+            # Trainer CheckpointManager layout: checkpoints/<step>/{state,meta}
+            mgr = ocp.CheckpointManager(path)
+            step = mgr.latest_step()
+            restored = mgr.restore(
+                step, args=ocp.args.Composite(state=ocp.args.StandardRestore())
+            )
+            source = restored["state"]["params"]
+        else:
+            if os.path.isdir(os.path.join(path, "params")):
+                path = os.path.join(path, "params")  # export artifact
+            source = ocp.StandardCheckpointer().restore(path)
+            if isinstance(source, dict) and "params" in source:
+                source = source["params"]
 
         flat_src = {
             tuple(str(getattr(k, "key", k)) for k in p): v

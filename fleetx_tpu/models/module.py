@@ -25,6 +25,18 @@ class BasicModule:
         q = (cfg.get("Quantization") or {}) if hasattr(cfg, "get") else {}
         self.quant_enabled = bool(q.get("enable"))
         self.quant_bits = int(q.get("weight_bits") or 8)
+        if self.quant_enabled:
+            from fleetx_tpu.utils.log import logger
+
+            wqt = q.get("weight_quantize_type")
+            if wqt not in (None, "abs_max", "channel_wise_abs_max"):
+                logger.warning(
+                    "weight_quantize_type=%r unsupported; using per-channel "
+                    "abs_max", wqt)
+            if q.get("activation_quantize_type"):
+                logger.warning(
+                    "activation quantization (%r) is not implemented — QAT "
+                    "here is weight-only", q["activation_quantize_type"])
         self.nets = self.get_model()
 
     def maybe_fake_quant(self, params):

@@ -18,7 +18,6 @@ __all__ = ["MoEModule"]
 class MoEModule(GPTModule):
     def loss_fn(self, params, batch, rng, train: bool):
         tokens, position_ids, labels, loss_mask = self.cp_prepare(batch)
-        params = self.maybe_fake_quant(params)
         logits, mutated = self.nets.apply(
             {"params": params},
             tokens,

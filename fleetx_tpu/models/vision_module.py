@@ -81,7 +81,6 @@ class GeneralClsModule(BasicModule):
         return self.nets.init(rng, jnp.asarray(batch["images"]))
 
     def loss_fn(self, params, batch, rng, train: bool):
-        params = self.maybe_fake_quant(params)
         images = batch["images"]
         labels = batch["labels"]
         n_cls = self.vit_config.num_classes

@@ -1,0 +1,35 @@
+"""Benchmark matrix harness: log parsing, and a 2-case live grid run
+(subprocess -> ips:/loss: parse -> convergence gate)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tools import bench_matrix
+
+
+def test_log_parsing():
+    line = ("[2026-01-01 00:00:00] [   TRAIN] [train] epoch: 0, batch: 2, "
+            "loss: 4.870062828, avg_batch_cost: 0.45283 sec, speed: 2.21 "
+            "step/s, ips_total: 4523 tokens/s, ips: 4523 tokens/s")
+    noise = "[    INFO]     scale_loss: 32768.0"
+    log = noise + "\n" + line
+    assert bench_matrix.IPS_RE.findall(log) == ["4523"]
+    assert bench_matrix.LOSS_RE.findall(log) == ["4.870062828"]
+
+
+def test_two_case_grid(monkeypatch, tmp_path):
+    monkeypatch.setattr(bench_matrix, "CASES_8", {
+        "DP8-MP1-PP1": {"Distributed.dp_degree": 8},
+        "DP4-MP2-PP1": {"Distributed.dp_degree": 4,
+                        "Distributed.mp_degree": 2},
+    })
+    out = tmp_path / "grid.json"
+    bench_matrix.main(["--steps", "2", "--out", str(out), "--timeout", "420"])
+    grid = json.loads(out.read_text())
+    assert grid["summary"]["passed"] == 2
+    assert not grid["summary"]["loss_diverged"]
+    for rec in grid["results"]:
+        assert rec["ips_tokens_per_s"] > 0
+        assert np.isfinite(rec["loss_last"])

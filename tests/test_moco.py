@@ -212,3 +212,56 @@ def test_moco_lincls_loads_pretrained_backbone(tmp_path, eight_devices):
     probe.cfg.Model.pretrained = str(bogus_dir)
     with pytest.raises(ValueError, match="no matching weights"):
         probe.load_pretrained(init)
+
+
+def test_moco_lincls_reads_trainer_checkpoint_layout(tmp_path):
+    """Model.pretrained pointing at a Trainer output dir (CheckpointManager
+    checkpoints/<step>/{state,meta}) must load — the shipped lincls config
+    uses exactly that layout."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    from fleetx_tpu.models import build_module
+    from fleetx_tpu.utils.config import AttrDict, process_configs
+
+    pre_cfg = AttrDict(
+        Global=AttrDict(seed=0, local_batch_size=2, micro_batch_size=2),
+        Engine=AttrDict(mix_precision=AttrDict(use_pure_fp16=False)),
+        Model=AttrDict(module="MOCOModule", backbone="resnet18", dim=16,
+                       queue_size=64, image_size=32, width=8),
+        Optimizer=AttrDict(name="Momentum", lr=AttrDict(
+            name="CosineDecay", learning_rate=0.03, decay_steps=10)),
+        Distributed=AttrDict(dp_degree=1),
+    )
+    process_configs(pre_cfg, nranks=1)
+    moco = build_module(pre_cfg)
+    batch = {"query": np.zeros((2, 32, 32, 3), np.float32),
+             "key": np.zeros((2, 32, 32, 3), np.float32)}
+    variables = moco.init_params(jax.random.PRNGKey(7), batch)
+
+    # mimic the engine's manager layout (engine.py save())
+    ckdir = tmp_path / "output" / "checkpoints"
+    mgr = ocp.CheckpointManager(str(ckdir))
+    mgr.save(3, args=ocp.args.Composite(
+        state=ocp.args.StandardSave(
+            {"step": np.int32(3), "params": dict(variables["params"])}),
+        meta=ocp.args.JsonSave({"epoch": 0, "consumed_samples": 0}),
+    ))
+    mgr.wait_until_finished()
+
+    cls_cfg = AttrDict(
+        Global=AttrDict(seed=0, local_batch_size=2, micro_batch_size=2),
+        Engine=AttrDict(mix_precision=AttrDict(use_pure_fp16=False)),
+        Model=AttrDict(module="MOCOClsModule", backbone="resnet18",
+                       num_classes=10, image_size=32, width=8,
+                       pretrained=str(tmp_path / "output")),
+        Optimizer=AttrDict(name="Momentum", lr=AttrDict(
+            name="CosineDecay", learning_rate=30.0, decay_steps=10)),
+        Distributed=AttrDict(dp_degree=1),
+    )
+    process_configs(cls_cfg, nranks=1)
+    probe = build_module(cls_cfg)
+    init = probe.init_params(jax.random.PRNGKey(0),
+                             {"images": batch["query"]})["params"]
+    loaded = probe.load_pretrained(init)
+    assert loaded is not None

@@ -1,0 +1,168 @@
+"""Benchmark topology matrix — the test_tipc harness, TPU-shaped.
+
+Capability parity with the reference CI benchmark grid
+(/root/reference/benchmarks/test_tipc/gpt/dygraph/hybrid_parallel/
+benchmark_common/run_benchmark.sh:20-22 and the N1C1/N1C8/N4C32 entry
+scripts): each case launches the REAL training CLI as a subprocess with
+``-o`` overrides over a shrunk model (the reference shrinks 24->4 layers so
+cases finish inside CI, run_benchmark.sh:84-87), parses the training log
+for the ``ips:`` keyword (tokens/s) and the ``loss:`` convergence keyword,
+emits one JSON record per case, and FAILS when any topology's loss diverges
+from the single-configuration baseline — all topologies see the same data
+and seed, so their losses must agree (the dp-vs-single math check).
+
+    python tools/bench_matrix.py                    # 8-device virtual CPU grid
+    python tools/bench_matrix.py --devices 1        # one real chip
+    python tools/bench_matrix.py --out grid.json --steps 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+IPS_RE = re.compile(r"ips_total: (\d+)")
+LOSS_RE = re.compile(r"loss: ([0-9.]+), avg_batch_cost")
+
+# the grid: name -> -o overrides (mirrors the reference's
+# DP{n}-MP{n}-PP{n} / sharding / SP case axes)
+CASES_8 = {
+    "DP8-MP1-PP1": {"Distributed.dp_degree": 8},
+    "DP4-MP2-PP1": {"Distributed.dp_degree": 4, "Distributed.mp_degree": 2},
+    "DP4-MP2-PP1-SP": {"Distributed.dp_degree": 4, "Distributed.mp_degree": 2,
+                       "Model.sequence_parallel": True},
+    "DP2-MP2-PP2": {"Distributed.dp_degree": 2, "Distributed.mp_degree": 2,
+                    "Distributed.pp_degree": 2},
+    "DP2-MP1-PP1-Sharding4-Stage2": {
+        "Distributed.dp_degree": 2,
+        "Distributed.sharding.sharding_degree": 4,
+        "Distributed.sharding.sharding_stage": 2,
+    },
+    "DP4-CP2": {"Distributed.dp_degree": 4, "Distributed.cp_degree": 2,
+                "Model.attention_probs_dropout_prob": 0.0},
+    "DP8-Recompute": {"Distributed.dp_degree": 8,
+                      "Model.use_recompute": True,
+                      "Model.recompute_granularity": "core_attn"},
+}
+CASES_1 = {
+    "DP1-MP1-PP1": {"Distributed.dp_degree": 1},
+}
+
+
+def make_dataset(tmp: str, vocab: int = 120) -> str:  # < tiny config vocab_size=128
+    rng = np.random.RandomState(0)
+    docs = [rng.randint(0, vocab, size=rng.randint(80, 200)).astype(np.int32)
+            for _ in range(64)]
+    prefix = os.path.join(tmp, "bench")
+    np.save(prefix + "_ids.npy", np.concatenate(docs))
+    np.savez(prefix + "_idx.npz",
+             lens=np.asarray([len(d) for d in docs], np.int32))
+    return prefix
+
+
+def run_case(name, overrides, args, data_prefix, tmp):
+    cmd = [
+        sys.executable, os.path.join(REPO, "tools", "train.py"),
+        "-c", os.path.join(REPO, "configs", "tiny", "pretrain_gpt_tiny_cpu.yaml"),
+        "-o", f"Engine.max_steps={args.steps}",
+        "-o", "Engine.logging_freq=1",
+        "-o", f"Data.Train.dataset.input_dir={data_prefix}",
+        "-o", f"Engine.save_load.output_dir={os.path.join(tmp, name)}",
+        "-o", f"Engine.mix_precision.use_pure_fp16={args.amp}",
+    ]
+    for k, v in overrides.items():
+        cmd += ["-o", f"{k}={v}"]
+    env = dict(os.environ)
+    # the parsed ips:/loss: lines log at INFO/TRAIN level; a quieter
+    # inherited level (e.g. the test conftest) would blank the log
+    env["FLEETX_LOG_LEVEL"] = "INFO"
+    if args.devices > 1:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.devices}"
+        )
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          timeout=args.timeout)
+    log = proc.stdout + proc.stderr
+    ips = [int(m) for m in IPS_RE.findall(log)]
+    losses = [float(m) for m in LOSS_RE.findall(log)]
+    record = {
+        # a run whose loss never parses (e.g. NaN) is a failure even if the
+        # process exits 0 — the convergence gate must not silently skip it
+        "case": name,
+        "ok": bool(proc.returncode == 0 and ips and losses
+                   and np.isfinite(losses[-1])),
+        "ips_tokens_per_s": ips[-1] if ips else None,  # steady-state (last)
+        "loss_first": losses[0] if losses else None,
+        "loss_last": losses[-1] if losses else None,
+        "overrides": overrides,
+    }
+    if not record["ok"]:
+        record["log_tail"] = log[-2000:]
+    return record
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8,
+                    help="8 = virtual CPU grid; 1 = current platform")
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--amp", default="False")
+    ap.add_argument("--timeout", type=int, default=900,
+                    help="per-case timeout (reference: timeout 15m)")
+    ap.add_argument("--loss-rtol", type=float, default=0.03,
+                    help="max relative final-loss divergence vs the first "
+                         "case (same data+seed => same math)")
+    ap.add_argument("--out", default=None, help="write the grid json here")
+    args = ap.parse_args(argv)
+
+    cases = CASES_1 if args.devices == 1 else CASES_8
+    results = []
+    with tempfile.TemporaryDirectory() as tmp:
+        data_prefix = make_dataset(tmp)
+        for name, overrides in cases.items():
+            rec = run_case(name, overrides, args, data_prefix, tmp)
+            results.append(rec)
+            print(json.dumps(rec))
+
+    failures = [r["case"] for r in results if not r["ok"]]
+    # convergence check: every topology must see the same loss (the data
+    # order and seed are fixed; the parallelism must not change the math)
+    ref = next((r for r in results if r["ok"]), None)
+    diverged = []
+    if ref and ref["loss_last"]:
+        for r in results:
+            if not r["ok"] or r["loss_last"] is None:
+                continue
+            rel = abs(r["loss_last"] - ref["loss_last"]) / abs(ref["loss_last"])
+            if rel > args.loss_rtol:
+                diverged.append((r["case"], round(rel, 4)))
+    summary = {
+        "metric": "bench_matrix",
+        "cases": len(results),
+        "passed": sum(r["ok"] for r in results),
+        "failed_cases": failures,
+        "loss_diverged": diverged,
+        "baseline_case": ref["case"] if ref else None,
+    }
+    print(json.dumps(summary))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"summary": summary, "results": results}, f, indent=2)
+    if failures or diverged:
+        raise SystemExit(f"bench matrix failed: {failures or diverged}")
+
+
+if __name__ == "__main__":
+    main()

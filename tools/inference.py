@@ -31,9 +31,12 @@ def main():
 
     export_dir = args.export_dir
     if export_dir is None and args.config:
-        from fleetx_tpu.utils.config import get_config
+        # parse + overrides only: serving must not run the training-topology
+        # validation (the serving host's device count is unrelated)
+        from fleetx_tpu.utils.config import override_config, parse_config
 
-        cfg = get_config(args.config, overrides=args.override, show=False)
+        cfg = parse_config(args.config)
+        override_config(cfg, args.override)
         export_dir = (cfg.get("Inference") or {}).get("model_dir")
     if not export_dir:
         ap.error("--export-dir or -c config with Inference.model_dir required")
