@@ -221,9 +221,11 @@ class Trainer:
         if os.path.isdir(os.path.join(self.output_dir, "checkpoints")):
             resumable = self._ckpt_manager().latest_step() is not None
         if resumable:
-            loaded = None  # load() will restore everything; re-reading the
-            # pretrained artifact would be wasted I/O (or a crash if it was
-            # cleaned up after the first run)
+            # restore the run's own checkpoint right here (don't just skip
+            # the pretrained load: callers only invoke load() when ckpt_dir
+            # is set, and a preempted run must not resume from random init)
+            self.load()
+            loaded = None
         else:
             loaded = self.module.load_pretrained(_unbox(self.state.params))
         if loaded is not None:

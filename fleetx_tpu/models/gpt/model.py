@@ -69,6 +69,14 @@ class GPTConfig:
     gate: str = "gshard"
     top_k: int = 2
     capacity_factor: float = 1.2
+    # 'einsum' = dense [n,E,C] dispatch masks (fastest at small E);
+    # 'scatter' = index scatter/gather, O(n) dispatch memory (large E);
+    # 'auto' picks scatter once the dense masks would dominate memory
+    moe_dispatch: str = "auto"
+    # virtual/interleaved pipeline: each physical stage owns this many
+    # non-contiguous layer chunks (reference num_virtual_pipeline_stages,
+    # hybrid_model.py:1095)
+    virtual_pp_degree: int = 1
     balance_loss_weight: float = 0.01
 
     @property
@@ -379,6 +387,7 @@ class GPTModel(nn.Module):
                 layer_cls,
                 cfg.pp_degree,
                 max(cfg.num_microbatches, 1),
+                virtual_pp=max(cfg.virtual_pp_degree, 1),
                 name="layers",
             )(x, attn_mask, deterministic)
         if cfg.scan_layers and not selective:

@@ -29,7 +29,7 @@ from flax import linen as nn
 
 from fleetx_tpu.models.gpt import model as gpt_model
 
-__all__ = ["MoEMLP", "compute_routing"]
+__all__ = ["MoEMLP", "compute_routing", "compute_routing_indices"]
 
 
 def _balance_loss(gate_probs: jax.Array, expert_mask: jax.Array) -> jax.Array:
@@ -41,18 +41,18 @@ def _balance_loss(gate_probs: jax.Array, expert_mask: jax.Array) -> jax.Array:
     return num_experts * jnp.sum(density * density_proxy)
 
 
-def compute_routing(
+def compute_routing_indices(
     gate_logits: jax.Array,  # [n_tokens, E]
     top_k: int,
     capacity: int,
     gate_type: str = "gshard",
     rng: Optional[jax.Array] = None,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Returns (dispatch [n, E, C] bool, combine [n, E, C] float, aux_loss).
-
-    Tokens beyond an expert's capacity are dropped (contribute zero output),
-    matching the reference's limit_by_capacity (moe/utils.py:125).
-    """
+):
+    """Sparse routing decisions: per (token, slot) the chosen expert, its
+    queue position, the combine weight, and the keep flag, plus the aux
+    balance loss. Tokens beyond an expert's capacity are dropped (reference
+    limit_by_capacity, moe/utils.py:125). O(n*k) memory — the scalable form
+    both dispatch implementations derive from."""
     n, num_experts = gate_logits.shape
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
 
@@ -74,23 +74,49 @@ def compute_routing(
     top1_mask = jax.nn.one_hot(topk_idx[:, 0], num_experts)
     aux = _balance_loss(probs, top1_mask)
 
-    # position of each token in its expert's queue, per top-k slot
-    dispatch = jnp.zeros((n, num_experts, capacity), jnp.bool_)
-    combine = jnp.zeros((n, num_experts, capacity), jnp.float32)
+    # queue position of each (token, slot) in its expert, slots filled in
+    # priority order (slot 0 of all tokens first — GShard convention)
+    pos = jnp.zeros((n, top_k), jnp.int32)
+    keep = jnp.zeros((n, top_k), jnp.bool_)
     fill = jnp.zeros((num_experts,), jnp.int32)
     for slot in range(top_k):
         e = topk_idx[:, slot]
         onehot = jax.nn.one_hot(e, num_experts, dtype=jnp.int32)
         pos_in_expert = (jnp.cumsum(onehot, axis=0) - onehot) + fill[None, :]
-        pos = jnp.take_along_axis(pos_in_expert, e[:, None], axis=1)[:, 0]
-        keep = (pos < capacity) & (topk_weights[:, slot] > 0)
-        pos_c = jnp.clip(pos, 0, capacity - 1)
-        dispatch = dispatch.at[jnp.arange(n), e, pos_c].max(keep)
-        combine = combine.at[jnp.arange(n), e, pos_c].add(
-            jnp.where(keep, topk_weights[:, slot], 0.0)
-        )
+        p = jnp.take_along_axis(pos_in_expert, e[:, None], axis=1)[:, 0]
+        k = (p < capacity) & (topk_weights[:, slot] > 0)
+        pos = pos.at[:, slot].set(jnp.clip(p, 0, capacity - 1))
+        keep = keep.at[:, slot].set(k)
         fill = fill + onehot.sum(axis=0)
 
+    return topk_idx, pos, topk_weights, keep, aux
+
+
+def compute_routing(
+    gate_logits: jax.Array,  # [n_tokens, E]
+    top_k: int,
+    capacity: int,
+    gate_type: str = "gshard",
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Dense form: (dispatch [n, E, C] bool, combine [n, E, C] float,
+    aux_loss), materialized from the sparse decisions. Memory scales as
+    n*E*C — fine for small expert counts, use the index path at scale."""
+    n, num_experts = gate_logits.shape
+    topk_idx, pos, topk_weights, keep, aux = compute_routing_indices(
+        gate_logits, top_k, capacity, gate_type, rng
+    )
+    dispatch = jnp.zeros((n, num_experts, capacity), jnp.bool_)
+    combine = jnp.zeros((n, num_experts, capacity), jnp.float32)
+    rows = jnp.arange(n)
+    for slot in range(topk_idx.shape[1]):
+        e = topk_idx[:, slot]
+        p = pos[:, slot]
+        k = keep[:, slot]
+        dispatch = dispatch.at[rows, e, p].max(k)
+        combine = combine.at[rows, e, p].add(
+            jnp.where(k, topk_weights[:, slot], 0.0)
+        )
     return dispatch, combine, aux
 
 
@@ -136,10 +162,16 @@ class MoEMLP(nn.Module):
             gate_logits = gate_logits * noise
 
         rng = self.make_rng("dropout") if (cfg.gate == "gshard" and self.has_rng("dropout")) else None
-        dispatch, combine, aux = compute_routing(
-            gate_logits, eff_top_k, capacity, cfg.gate, rng
-        )
-        self.sow("intermediates", "balance_loss", aux)
+        mode = getattr(cfg, "moe_dispatch", "auto")
+        if mode not in ("auto", "einsum", "scatter"):
+            raise ValueError(
+                f"moe_dispatch={mode!r}; choose auto | einsum | scatter")
+        if mode == "auto":
+            # dense masks cost n*E*C floats; the scatter path costs n*h
+            # gathers — switch over when the masks would exceed the
+            # activations they route (capacity is ~n*k/E, so the dense form
+            # grows quadratically in tokens)
+            mode = "scatter" if n * E * capacity > 8 * n * h else "einsum"
 
         def ffn_param(name, shape, axes):
             return self.param(
@@ -155,6 +187,42 @@ class MoEMLP(nn.Module):
         b_down = ffn_param("b_down", (E, h), ("expert", "embed"))
 
         dt = cfg.dtype
+        if mode == "scatter":
+            # index dispatch (reference MoEScatter/MoEGather all-to-all
+            # semantics, comm_ops.py:28-118): scatter-add tokens into the
+            # per-expert buffers, gather weighted results back. GSPMD lowers
+            # the token->expert reshuffle to the all-to-all the reference
+            # hand-writes; no [n, E, C] mask is ever materialized.
+            topk_idx, pos, weights, keep, aux = compute_routing_indices(
+                gate_logits, eff_top_k, capacity, cfg.gate, rng
+            )
+            self.sow("intermediates", "balance_loss", aux)
+            buf = jnp.zeros((E * capacity, h), dt)
+            for slot in range(eff_top_k):
+                flat = topk_idx[:, slot] * capacity + pos[:, slot]
+                contrib = tokens.astype(dt) * keep[:, slot, None].astype(dt)
+                buf = buf.at[flat].add(contrib)
+            expert_in = buf.reshape(E, capacity, h)
+            hidden = jax.nn.gelu(
+                jnp.einsum("ech,ehf->ecf", expert_in, w_up.astype(dt))
+                + b_up[:, None, :].astype(dt),
+                approximate=True,
+            )
+            expert_out = (
+                jnp.einsum("ecf,efh->ech", hidden, w_down.astype(dt))
+                + b_down[:, None, :].astype(dt)
+            ).reshape(E * capacity, h)
+            out = jnp.zeros((n, h), dt)
+            for slot in range(eff_top_k):
+                flat = topk_idx[:, slot] * capacity + pos[:, slot]
+                w = (weights[:, slot] * keep[:, slot]).astype(dt)[:, None]
+                out = out + expert_out[flat] * w
+            return out.reshape(b, s, h)
+
+        dispatch, combine, aux = compute_routing(
+            gate_logits, eff_top_k, capacity, cfg.gate, rng
+        )
+        self.sow("intermediates", "balance_loss", aux)
         expert_in = jnp.einsum(
             "nh,nec->ech", tokens.astype(dt), dispatch.astype(dt)
         )

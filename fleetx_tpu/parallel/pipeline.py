@@ -23,6 +23,20 @@ Gradient flow is standard autodiff through the scan; per-stage remat bounds
 activation memory (the reference's 1F1B memory schedule is a runtime
 scheduling choice NCCL needs; under XLA the scan + remat achieves the same
 peak-memory class).
+
+Virtual/interleaved stages (reference ``num_virtual_pipeline_stages``,
+hybrid_model.py:1095): with ``virtual_pp=v`` each physical stage owns v
+non-contiguous layer chunks (stage p holds global chunks {p, p+pp, ...}),
+and a microbatch makes v passes through the stage ring — chunk pass j is
+its own scan with statically selected chunk parameters, chained on pass
+j-1's emission stream. The math matches the reference exactly; the timing
+differs by design: the reference's interleaved 1F1B is a *runtime*
+schedule (a rank hops between chunk kernels mid-stream), which a single
+statically-scheduled XLA program does not express. In this SPMD pipe the
+bubble shrinks by raising ``num_microbatches`` (cheap here — microbatches
+stream through one compiled scan, no host loop), while virtual stages
+keep their other role: finer-grained layer placement so each stage's
+weights/activations split v ways.
 """
 
 from __future__ import annotations
@@ -42,6 +56,11 @@ __all__ = [
 
 _SEQ_PREFIX = "gpt/layers/layer/"
 _PIPE_PREFIX = "gpt/layers/pipe/stages/layers/layer/"
+# single source of truth for the virtual-chunk scope name: the scan scope
+# in PipelinedStack, the forward remap, the inverse regex, and the layout
+# detector all derive from this template
+_VPIPE_SCOPE = "pipe_chunk{j}"
+_VPIPE_RE = "gpt/layers/" + _VPIPE_SCOPE + "/stages/layers/layer/"
 
 
 def _flatten(variables):
@@ -59,40 +78,67 @@ def _unflatten(flat, wrap):
     return {"params": tree} if wrap else tree
 
 
-def sequential_params_to_pipeline(variables, pp: int):
+def sequential_params_to_pipeline(variables, pp: int, virtual_pp: int = 1):
     """Remap a sequential-scan param tree (gpt/layers/layer/* with leading
-    [num_layers] axis) to the pipeline layout (gpt/layers/pipe/stages/
-    layers/layer/* with leading [pp, layers_per_stage] axes)."""
+    [num_layers] axis) to the pipeline layout: [pp, layers_per_stage]
+    leading axes under gpt/layers/pipe/... — or, with virtual stages, one
+    [pp, layers_per_chunk] tree per chunk pass, stage p of pass j holding
+    global chunk j*pp + p (the reference's interleaved chunk placement)."""
     flat, wrap = _flatten(variables)
     out = {}
-    for k, v in flat.items():
-        if k.startswith(_SEQ_PREFIX):
-            nk = _PIPE_PREFIX + k[len(_SEQ_PREFIX):]
-            out[nk] = v.reshape((pp, v.shape[0] // pp) + v.shape[1:])
-        else:
-            out[k] = v
+    for k, val in flat.items():
+        if not k.startswith(_SEQ_PREFIX):
+            out[k] = val
+            continue
+        suffix = k[len(_SEQ_PREFIX):]
+        L = val.shape[0]
+        if virtual_pp <= 1:
+            out[_PIPE_PREFIX + suffix] = val.reshape(
+                (pp, L // pp) + val.shape[1:])
+            continue
+        lpc = L // (pp * virtual_pp)
+        # [L,...] -> [v*pp, lpc, ...]; pass j stage p = global chunk j*pp+p
+        chunks = val.reshape((virtual_pp * pp, lpc) + val.shape[1:])
+        for j in range(virtual_pp):
+            out[_VPIPE_RE.format(j=j) + suffix] = chunks[
+                j * pp:(j + 1) * pp]
     return _unflatten(out, wrap)
 
 
 def pipeline_params_to_sequential(variables):
-    """Inverse of :func:`sequential_params_to_pipeline`: merge the
-    [pp, layers_per_stage] leading axes back into [num_layers] so a
+    """Inverse of :func:`sequential_params_to_pipeline` (plain and virtual
+    layouts): merge the chunk/stage axes back into [num_layers] so a
     pipeline-trained checkpoint can drive the scan decode/eval path."""
+    import re
+
     flat, wrap = _flatten(variables)
     out = {}
+    vchunks = {}
     for k, v in flat.items():
-        if k.startswith(_PIPE_PREFIX):
+        pattern = "^" + re.escape(_VPIPE_RE.format(j="@")).replace(
+            "@", r"(\d+)") + "(.*)"
+        m = re.match(pattern, k)
+        if m:
+            j, suffix = int(m.group(1)), m.group(2)
+            vchunks.setdefault(suffix, {})[j] = v
+        elif k.startswith(_PIPE_PREFIX):
             nk = _SEQ_PREFIX + k[len(_PIPE_PREFIX):]
             out[nk] = v.reshape((v.shape[0] * v.shape[1],) + v.shape[2:])
         else:
             out[k] = v
+    for suffix, by_chunk in vchunks.items():
+        parts = [by_chunk[j] for j in sorted(by_chunk)]
+        stacked = jnp.concatenate(parts, axis=0)  # [v*pp, lpc, ...]
+        out[_SEQ_PREFIX + suffix] = stacked.reshape(
+            (stacked.shape[0] * stacked.shape[1],) + stacked.shape[2:])
     return _unflatten(out, wrap)
 
 
 def maybe_pipeline_params_to_sequential(variables):
     """Remap iff the tree holds pipeline-layout params; no-op otherwise."""
     flat, _ = _flatten(variables)
-    if any(k.startswith(_PIPE_PREFIX) for k in flat):
+    marker = "/" + _VPIPE_SCOPE.format(j="")
+    if any(k.startswith(_PIPE_PREFIX) or marker in k for k in flat):
         return pipeline_params_to_sequential(variables)
     return variables
 
@@ -119,7 +165,11 @@ class _StageStack(nn.Module):
 
 
 class _PipelineTick(nn.Module):
-    """One pipeline time step: shift, inject, apply all stages in parallel."""
+    """One pipeline time step: shift, inject, apply all stages in parallel.
+
+    ``state``/``inject`` are (x, mask) pairs when a per-example attention
+    mask streams with its microbatch (mask=None otherwise — batch-agnostic
+    masks broadcast instead of streaming)."""
 
     cfg: Any
     layer_cls: Callable
@@ -130,11 +180,18 @@ class _PipelineTick(nn.Module):
     def __call__(self, state, inject, attn_mask, deterministic):
         # shift: stage k receives stage k-1's output (ppermute over 'pp');
         # stage 0 receives the next microbatch
-        shifted = jnp.roll(state, 1, axis=0)
-        shifted = shifted.at[0].set(inject)
+        x_state, m_state = state
+        x_inj, m_inj = inject
+        shifted = jnp.roll(x_state, 1, axis=0).at[0].set(x_inj)
+        if m_state is not None:
+            m_shifted = jnp.roll(m_state, 1, axis=0).at[0].set(m_inj)
+            stage_mask_axis = 0
+        else:
+            m_shifted = attn_mask  # batch-agnostic: same for every stage
+            stage_mask_axis = None
         stages = nn.vmap(
             _StageStack,
-            in_axes=(0, None, None),
+            in_axes=(0, stage_mask_axis, None),
             out_axes=0,
             variable_axes={"params": 0, "intermediates": 0},
             split_rngs={"params": True, "dropout": True},
@@ -145,21 +202,24 @@ class _PipelineTick(nn.Module):
         )
         new_state = stages(
             self.cfg, self.layer_cls, self.layers_per_stage, name="stages"
-        )(shifted, attn_mask, deterministic)
+        )(shifted, m_shifted, deterministic)
         new_state = nn.with_logical_constraint(
             new_state, ("stage", "act_batch", "act_seq", "act_embed")
         )
-        return new_state, new_state[self.pp - 1]
+        return (new_state, m_shifted if m_state is not None else None), \
+            new_state[self.pp - 1]
 
 
 class PipelinedStack(nn.Module):
     """Drop-in decoder stack for pp>1. Input [b, s, h]; b is split into
-    ``num_microbatches`` microbatches that stream through the stages."""
+    ``num_microbatches`` microbatches that stream through the stages
+    ``virtual_pp`` times (once per layer chunk)."""
 
     cfg: Any
     layer_cls: Callable
     pp: int
     num_microbatches: int
+    virtual_pp: int = 1
 
     @nn.compact
     def __call__(self, x, attn_mask=None, deterministic=True):
@@ -167,18 +227,26 @@ class PipelinedStack(nn.Module):
         pp = self.pp
         M = self.num_microbatches
         b, s, h = x.shape
-        if attn_mask is not None and attn_mask.ndim >= 1 and attn_mask.shape[0] not in (1,):
-            # a per-example mask would need to stream through the stage
-            # buffer alongside x; only batch-agnostic masks are supported
+        # per-example masks ([b, ...]) stream through the stage buffer with
+        # their microbatch; batch-agnostic masks (leading dim 1 or None)
+        # broadcast to every stage
+        per_example = (
+            attn_mask is not None and attn_mask.ndim >= 1
+            and attn_mask.shape[0] == b and b > 1
+        )
+        if (attn_mask is not None and not per_example
+                and attn_mask.shape[0] != 1):
             raise ValueError(
-                "PipelinedStack supports only batch-agnostic attn_mask "
-                f"(leading dim 1), got shape {attn_mask.shape}"
+                "attn_mask leading dim must be the batch or 1, got "
+                f"{attn_mask.shape} for batch {b}"
             )
-        if cfg.num_layers % pp:
-            raise ValueError(f"num_layers {cfg.num_layers} % pp {pp} != 0")
+        v = max(self.virtual_pp, 1)
+        if cfg.num_layers % (pp * v):
+            raise ValueError(
+                f"num_layers {cfg.num_layers} % (pp {pp} * virtual {v}) != 0")
         if b % M:
             raise ValueError(f"batch {b} % num_microbatches {M} != 0")
-        layers_per_stage = cfg.num_layers // pp
+        layers_per_stage = cfg.num_layers // (pp * v)
         mb = b // M
 
         micro = x.reshape(M, mb, s, h)
@@ -187,20 +255,39 @@ class PipelinedStack(nn.Module):
         inject_stream = jnp.concatenate([micro, pad], axis=0)
 
         state0 = jnp.zeros((pp, mb, s, h), x.dtype)
+        if per_example:
+            m = attn_mask.reshape((M, mb) + attn_mask.shape[1:])
+            m_pad = jnp.zeros((pp - 1,) + m.shape[1:], m.dtype)
+            m_stream = jnp.concatenate([m, m_pad], axis=0)
+            m_state0 = jnp.zeros((pp,) + m.shape[1:], m.dtype)
+            bcast_mask = None
+        else:
+            m_stream = None
+            m_state0 = None
+            bcast_mask = attn_mask
 
-        tick = nn.scan(
-            _PipelineTick,
-            variable_broadcast="params",
-            variable_axes={"intermediates": 0},
-            split_rngs={"params": False, "dropout": True},
-            in_axes=(0, nn.broadcast, nn.broadcast),
-            out_axes=0,
-            length=M + pp - 1,
-        )
-        _, emitted = tick(
-            cfg, self.layer_cls, pp, layers_per_stage, name="pipe"
-        )(state0, inject_stream, attn_mask, deterministic)
+        def chunk_pass(j, inj_stream):
+            tick = nn.scan(
+                _PipelineTick,
+                variable_broadcast="params",
+                variable_axes={"intermediates": 0},
+                split_rngs={"params": False, "dropout": True},
+                in_axes=((0, 0 if per_example else nn.broadcast), nn.broadcast,
+                         nn.broadcast),
+                out_axes=0,
+                length=M + pp - 1,
+            )
+            name = "pipe" if v == 1 else _VPIPE_SCOPE.format(j=j)
+            _, emitted = tick(
+                cfg, self.layer_cls, pp, layers_per_stage, name=name
+            )((state0, m_state0), (inj_stream, m_stream), bcast_mask,
+              deterministic)
+            # microbatch m exits the last stage at tick m + pp - 1
+            return emitted[pp - 1:]
 
-        # microbatch m exits the last stage at tick m + pp - 1
-        out = emitted[pp - 1 :]
+        stream = inject_stream
+        for j in range(v):
+            out = chunk_pass(j, stream)
+            if j < v - 1:
+                stream = jnp.concatenate([out, pad], axis=0)
         return out.reshape(b, s, h)

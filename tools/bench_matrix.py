@@ -92,16 +92,24 @@ def run_case(name, overrides, args, data_prefix, tmp):
             env.get("XLA_FLAGS", "")
             + f" --xla_force_host_platform_device_count={args.devices}"
         )
-    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
-                          timeout=args.timeout)
-    log = proc.stdout + proc.stderr
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                              timeout=args.timeout)
+        log = proc.stdout + proc.stderr
+        returncode = proc.returncode
+    except subprocess.TimeoutExpired as e:
+        # fail this case only; the rest of the grid must still run
+        log = ((e.stdout or b"").decode("utf-8", "replace")
+               + (e.stderr or b"").decode("utf-8", "replace")
+               + f"\n[bench_matrix] case timed out after {args.timeout}s")
+        returncode = -1
     ips = [int(m) for m in IPS_RE.findall(log)]
     losses = [float(m) for m in LOSS_RE.findall(log)]
     record = {
         # a run whose loss never parses (e.g. NaN) is a failure even if the
         # process exits 0 — the convergence gate must not silently skip it
         "case": name,
-        "ok": bool(proc.returncode == 0 and ips and losses
+        "ok": bool(returncode == 0 and ips and losses
                    and np.isfinite(losses[-1])),
         "ips_tokens_per_s": ips[-1] if ips else None,  # steady-state (last)
         "loss_first": losses[0] if losses else None,
