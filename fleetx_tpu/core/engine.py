@@ -14,6 +14,7 @@ step/epoch/consumed-samples resume parity (eager_engine.py:634-725).
 
 from __future__ import annotations
 
+import itertools
 import os
 import time
 from typing import Any, Dict, Iterable, Optional
@@ -178,6 +179,8 @@ class Trainer:
         )
 
         self._compiled = {}
+        self._compiled_raw = {}
+        self._restored_step = None
         self.state: Optional[TrainState] = None
         self.start_epoch = 0
         self.consumed_samples = 0
@@ -389,7 +392,9 @@ class Trainer:
 
     def _get(self, name, builder):
         if name not in self._compiled:
-            self._compiled[name] = self._in_context(builder())
+            raw = builder()
+            self._compiled_raw[name] = raw  # jitted fn, for cost_analysis
+            self._compiled[name] = self._in_context(raw)
         return self._compiled[name]
 
     def _in_context(self, fn):
@@ -481,6 +486,10 @@ class Trainer:
                 rng = dist_env.data_rank_key(step)
                 self.state, metrics = train_step(self.state, device_batch, rng)
                 step += 1
+                # tick before the logging/eval/save hooks so the profiled
+                # step-time window measures the train step, not a periodic
+                # evaluation pass or checkpoint write
+                self._profiler_step(step)
                 self.consumed_samples += self.cfg.Global.global_batch_size
                 loss_window.append(metrics["loss"])
 
@@ -505,7 +514,6 @@ class Trainer:
                     self.evaluate(valid_data, epoch=epoch)
                 if self.save_steps and step % self.save_steps == 0:
                     self.save(epoch=epoch)
-                self._profiler_step(step)
             if step >= self.max_steps:
                 break
         self._profiler_maybe_stop()
@@ -513,13 +521,18 @@ class Trainer:
 
     # ------------------------------------------------------------------- eval
     def evaluate(self, valid_data: Iterable, epoch: int = 0):
+        batches = iter(valid_data)
         if self.state is None:
-            first = self.module.pretreating_batch(next(iter(valid_data)))
-            self.init_state(first)
+            try:
+                first = next(batches)
+            except StopIteration:
+                return None
+            self.init_state(self.module.pretreating_batch(first))
+            batches = itertools.chain([first], batches)  # don't drop batch 0
         eval_step = self._get("eval", self._build_eval_step)
         losses = []
         t0 = time.time()
-        for i, batch in enumerate(valid_data):
+        for i, batch in enumerate(batches):
             if i >= self.eval_iters:
                 break
             batch = self.module.pretreating_batch(batch)
@@ -538,7 +551,49 @@ class Trainer:
         return float(np.mean(losses)) if losses else None
 
     def predict(self, data: Iterable):
-        raise NotImplementedError("use GenerationModule / InferenceEngine")
+        """Forward the module over ``data`` batches, returning host outputs
+        per batch (reference predict loop, eager_engine.py:502-632;
+        serving-grade inference over an export artifact stays in
+        InferenceEngine). Uses the module's serving contract so the fed keys
+        match what export/inference would serve."""
+        from fleetx_tpu.utils.export import serving_contract
+
+        spec = self.module.input_spec() or {}
+        fwd, keys = serving_contract(self.module, spec)
+        if fwd is None:
+            raise NotImplementedError(
+                "module has no serving contract; use GenerationModule / "
+                "InferenceEngine or override serving_forward()"
+            )
+        batches = iter(data)
+        if self.state is None:
+            try:
+                first = next(batches)
+            except StopIteration:
+                return []
+            self.init_state(self.module.pretreating_batch(first))
+            batches = itertools.chain([first], batches)  # don't drop batch 0
+
+        def _build_predict_step():
+            module = self.module
+
+            def predict_step(state: TrainState, feed):
+                return fwd(module.maybe_fake_quant(state.params), feed)
+
+            batch_sh = NamedSharding(self.mesh, P(DATA_AXES))
+            return jax.jit(
+                predict_step,
+                in_shardings=(self._state_sharding_tree, batch_sh),
+            )
+
+        predict_step = self._get("predict", _build_predict_step)
+        outputs = []
+        for batch in batches:
+            batch = self.module.pretreating_batch(batch)
+            feed = {k: batch[k] for k in keys}
+            feed = self._shard_batch(feed, for_train=False)
+            outputs.append(np.asarray(jax.device_get(predict_step(self.state, feed))))
+        return outputs
 
     # ------------------------------------------------------------- checkpoint
     def _ckpt_manager(self):
@@ -598,6 +653,14 @@ class Trainer:
         if step is None:
             logger.warning("no checkpoint found under %s", self.output_dir)
             return False
+        if (
+            step == self._restored_step
+            and self.state is not None
+            and int(self.state.step) == step
+        ):
+            # init_state already restored this step (its resumable branch);
+            # don't pay the multi-GB orbax restore twice on CLI resume paths
+            return True
         if self.state is None:
             raise RuntimeError("call init_state (or fit) before load, to build shardings")
         abstract = jax.tree.map(
@@ -622,6 +685,7 @@ class Trainer:
         meta = restored["meta"]
         self.start_epoch = meta.get("epoch", 0)
         self.consumed_samples = meta.get("consumed_samples", 0)
+        self._restored_step = step
         logger.info("restored checkpoint step %d (epoch %d)", step, self.start_epoch)
         return True
 
@@ -643,13 +707,32 @@ class Trainer:
         if not self._prof_running and step >= lo:
             jax.profiler.start_trace(self._prof_dir)
             self._prof_running = True
+            self._prof_ticks = [time.perf_counter()]
+        elif self._prof_running:
+            self._prof_ticks.append(time.perf_counter())
         if self._prof_running and step >= hi:
+            jax.block_until_ready(self.state.params)  # close the async tail
+            self._prof_ticks.append(time.perf_counter())
             jax.profiler.stop_trace()
             self._prof_running = False
             self._prof_enabled = False
             logger.info("profiler trace written to %s", self._prof_dir)
+            self._print_summary()
+
+    def _print_summary(self):
+        """Reference _print_summary (eager_engine.py:761-820): configurable
+        overview/model/kernel/mem views after the profiling window."""
+        from fleetx_tpu.utils.profiler_summary import print_summary
+
+        ticks = getattr(self, "_prof_ticks", [])
+        step_times = [b - a for a, b in zip(ticks, ticks[1:])]
+        print_summary(
+            self, dict(self.cfg.get("Profiler") or {}), self._prof_dir,
+            step_times,
+        )
 
     def _profiler_maybe_stop(self):
         if getattr(self, "_prof_running", False):
             jax.profiler.stop_trace()
             self._prof_running = False
+            self._print_summary()

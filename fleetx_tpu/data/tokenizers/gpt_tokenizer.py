@@ -112,7 +112,9 @@ class GPTTokenizer:
         return ids
 
     def decode(self, ids) -> str:
-        text = "".join(self.decoder[int(i)] for i in ids)
+        # ids outside the vocab (e.g. a model whose padded vocab_size exceeds
+        # len(vocab.json)) decode to nothing rather than crash serving
+        text = "".join(self.decoder.get(int(i), "") for i in ids)
         return bytearray(self.byte_decoder[c] for c in text).decode(
             "utf-8", errors=self.errors
         )

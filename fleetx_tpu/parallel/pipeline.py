@@ -114,10 +114,11 @@ def pipeline_params_to_sequential(variables):
     flat, wrap = _flatten(variables)
     out = {}
     vchunks = {}
+    pattern = re.compile(
+        "^" + re.escape(_VPIPE_RE.format(j="@")).replace("@", r"(\d+)") + "(.*)"
+    )
     for k, v in flat.items():
-        pattern = "^" + re.escape(_VPIPE_RE.format(j="@")).replace(
-            "@", r"(\d+)") + "(.*)"
-        m = re.match(pattern, k)
+        m = pattern.match(k)
         if m:
             j, suffix = int(m.group(1)), m.group(2)
             vchunks.setdefault(suffix, {})[j] = v

@@ -1,0 +1,196 @@
+"""Profiler summary views (reference EagerEngine._print_summary,
+/root/reference/ppfleetx/core/engine/eager_engine.py:761-820: prints
+overview/model/kernel/op/mem summaries from the paddle profiler, view set
+configurable via ``Profiler.summary`` with a ``detailed`` override).
+
+TPU equivalents, assembled from what XLA/JAX actually exposes:
+- overview: wall-time stats of the profiled steps (collected by the Trainer)
+- model:    param/opt-state footprint + XLA cost analysis of the compiled
+            train step (flops / bytes accessed per step)
+- kernel:   top ops by total self-duration, parsed from the Chrome-trace
+            .trace.json.gz the jax profiler writes under
+            ``{log_dir}/plugins/profile/<run>/``
+- mem:      per-device live/peak HBM from device.memory_stats()
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from fleetx_tpu.utils.log import logger
+
+__all__ = ["print_summary"]
+
+_DEFAULT_VIEWS = ("overview", "model", "kernel", "mem")
+_ALL_VIEWS = ("overview", "model", "kernel", "mem")
+
+
+def _selected_views(profiler_cfg: Dict) -> List[str]:
+    if profiler_cfg.get("detailed"):
+        return list(_ALL_VIEWS)
+    chosen = profiler_cfg.get("summary") or {}
+    views = [v for v in _ALL_VIEWS
+             if chosen.get(v, v in _DEFAULT_VIEWS)]
+    return views
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0:
+            return f"{n:.2f} {unit}"
+        n /= 1024.0
+    return f"{n:.2f} PiB"
+
+
+def _rule(title: str) -> str:
+    pad = max(4, 72 - len(title) - 2)
+    return f"--- {title} {'-' * pad}"
+
+
+def _overview(step_times: List[float]):
+    logger.info(_rule("profiler overview"))
+    if not step_times:
+        logger.info("no step timings collected in the profiled window")
+        return
+    t = np.asarray(step_times)
+    logger.info(
+        "steps profiled: %d | step time mean %.2f ms, min %.2f ms, "
+        "max %.2f ms, p50 %.2f ms",
+        t.size, t.mean() * 1e3, t.min() * 1e3, t.max() * 1e3,
+        float(np.percentile(t, 50)) * 1e3,
+    )
+
+
+def _model(trainer):
+    import jax
+
+    logger.info(_rule("model view"))
+    try:
+        params = trainer.state.params
+        n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+        p_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+        o_bytes = sum(
+            x.size * x.dtype.itemsize
+            for x in jax.tree.leaves(trainer.state.opt_state)
+            if hasattr(x, "dtype")
+        )
+        logger.info(
+            "params: %.1fM (%s) | opt state: %s",
+            n_params / 1e6, _fmt_bytes(p_bytes), _fmt_bytes(o_bytes),
+        )
+    except Exception as e:  # state not initialized — still print cost info
+        logger.info("param stats unavailable: %s", e)
+    cost = None
+    try:
+        # the jitted step exposes XLA's static cost model post-compile
+        jitted = trainer._compiled_raw.get("train")
+        if jitted is not None:
+            cost = jitted.cost_analysis()
+    except Exception:
+        cost = None
+    if cost:
+        flops = cost.get("flops", 0.0)
+        logger.info(
+            "xla cost analysis (per step): %.2f GFLOP, %s accessed",
+            flops / 1e9, _fmt_bytes(cost.get("bytes accessed", 0.0)),
+        )
+
+
+def _kernel(log_dir: str, top_k: int = 15):
+    logger.info(_rule("kernel view (top ops by self time)"))
+    traces = sorted(
+        glob.glob(os.path.join(log_dir, "plugins", "profile", "*",
+                               "*.trace.json.gz")),
+        key=os.path.getmtime,
+    )
+    if not traces:
+        logger.info("no trace found under %s", log_dir)
+        return
+    try:
+        with gzip.open(traces[-1], "rt") as f:
+            trace = json.load(f)
+    except Exception as e:
+        logger.info("trace unreadable (%s): %s", traces[-1], e)
+        return
+    events = trace.get("traceEvents", [])
+    # pid->process name so we can keep device (TPU/XLA) tracks and drop the
+    # python host threads, which would otherwise double-count everything
+    proc_names = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            proc_names[ev.get("pid")] = (ev.get("args") or {}).get("name", "")
+    device_pids = {
+        pid for pid, name in proc_names.items()
+        if any(s in name for s in ("TPU", "GPU", "/device:", "XLA Op"))
+    }
+    totals = defaultdict(float)
+    counts = defaultdict(int)
+    for ev in events:
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        if device_pids and ev.get("pid") not in device_pids:
+            continue
+        totals[ev["name"]] += ev["dur"]
+        counts[ev["name"]] += 1
+    if not totals:
+        logger.info("trace had no complete device events")
+        return
+    grand = sum(totals.values())
+    logger.info("%-48s %10s %8s %7s", "op", "total(us)", "calls", "%")
+    for name, dur in sorted(totals.items(), key=lambda kv: -kv[1])[:top_k]:
+        shown = name if len(name) <= 48 else name[:45] + "..."
+        logger.info(
+            "%-48s %10.0f %8d %6.1f%%",
+            shown, dur, counts[name], 100.0 * dur / grand,
+        )
+
+
+def _mem():
+    import jax
+
+    logger.info(_rule("memory view"))
+    any_stats = False
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        any_stats = True
+        logger.info(
+            "%s: in use %s | peak %s | limit %s",
+            d, _fmt_bytes(stats.get("bytes_in_use", 0)),
+            _fmt_bytes(stats.get("peak_bytes_in_use", 0)),
+            _fmt_bytes(stats.get("bytes_limit", 0)),
+        )
+    if not any_stats:
+        logger.info("device memory stats not exposed on this platform")
+
+
+def print_summary(
+    trainer,
+    profiler_cfg: Dict,
+    log_dir: str,
+    step_times: Optional[List[float]] = None,
+):
+    """Print the configured summary views after a profiling window closes."""
+    views = _selected_views(profiler_cfg)
+    if "overview" in views:
+        _overview(step_times or [])
+    if "model" in views:
+        _model(trainer)
+    if "kernel" in views:
+        _kernel(log_dir)
+    if "mem" in views:
+        _mem()
+    logger.info(
+        "full timeline: tensorboard --logdir %s (or xprof)", log_dir
+    )

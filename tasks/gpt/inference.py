@@ -1,0 +1,44 @@
+"""One-shot inference driver over an export artifact (reference
+/root/reference/tasks/gpt/inference.py:35-62: builds the module in
+mode='inference', encodes a prompt, runs engine.inference, decodes).
+
+    python tasks/gpt/inference.py --export-dir ./exported --vocab-dir ./vocab \
+        --prompt "Hi, GPT2. Tell me who Jack Ma is."
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+from fleetx_tpu.core.inference_engine import InferenceEngine
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--export-dir", required=True)
+    ap.add_argument("--vocab-dir", default="./vocab")
+    ap.add_argument("--prompt", default="Hi, GPT2. Tell me who Jack Ma is.")
+    ap.add_argument("--max-length", type=int, default=128)
+    args = ap.parse_args()
+
+    from fleetx_tpu.data.tokenizers.gpt_tokenizer import GPTTokenizer
+
+    tok = GPTTokenizer.from_pretrained(args.vocab_dir)
+    engine = InferenceEngine(args.export_dir)
+
+    ids = np.asarray([tok.encode(args.prompt)], np.int32)
+    out = np.asarray(engine.generate(ids, max_length=args.max_length))
+    gen = out[0][ids.shape[1]:]
+    eos = np.nonzero(gen == engine.eos_token_id)[0]
+    if eos.size:  # trim EOS + the post-EOS pad fill
+        gen = gen[: eos[0]]
+    print("Prompt:", args.prompt)
+    print("Generation:", args.prompt + tok.decode(gen))
+
+
+if __name__ == "__main__":
+    main()
