@@ -95,16 +95,20 @@ class ErnieSelfAttention(nn.Module):
         dropout_rng = None
         if cfg.attention_probs_dropout_prob > 0.0 and not deterministic:
             dropout_rng = self.make_rng("dropout")
+        # (dense [b,1,1,s] mask, per-example kv lengths) — exactly one set.
+        # kv_lens rides the non-causal flash kernel; a dense mask falls back
+        # to the XLA path (fleetx_tpu/ops/attention.py dispatch).
+        mask4, kv_lens = attn_mask
         out = causal_attention(
             q,
             k,
             v,
             causal=False,
-            attn_mask=attn_mask,
+            attn_mask=mask4,
+            kv_lens=kv_lens,
             dropout_rate=cfg.attention_probs_dropout_prob,
             dropout_rng=dropout_rng,
             deterministic=deterministic,
-            use_flash=False,  # non-causal + padding mask: XLA path
         )
         return attn_out_dense(cfg.hidden_size, cfg.dtype)(out)
 
@@ -155,8 +159,12 @@ class ErnieModel(nn.Module):
         cfg = self.cfg
         if attention_mask is None:
             attention_mask = (input_ids != cfg.pad_token_id).astype(jnp.int32)
-        # [b, s] -> broadcastable [b, 1, 1, s] key-side padding mask
-        mask4 = attention_mask[:, None, None, :]
+            # shipped datasets right-pad, so the derived mask is a prefix
+            # mask the flash kernel expresses as per-example key lengths
+            masks = (None, jnp.sum(attention_mask, axis=-1).astype(jnp.int32))
+        else:
+            # arbitrary user mask -> broadcastable [b, 1, 1, s] dense form
+            masks = (attention_mask[:, None, None, :], None)
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         if position_ids is None:
@@ -207,10 +215,10 @@ class ErnieModel(nn.Module):
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )
-            x, _ = stack(cfg, name="layers")(x, mask4, deterministic)
+            x, _ = stack(cfg, name="layers")(x, masks, deterministic)
         else:
             for i in range(cfg.num_layers):
-                x, _ = layer_cls(cfg, name=f"layers_{i}")(x, mask4, deterministic)
+                x, _ = layer_cls(cfg, name=f"layers_{i}")(x, masks, deterministic)
 
         pooled = _dense(cfg.hidden_size, ("embed", None), "pooler", dtype=cfg.dtype)(
             x[:, 0]

@@ -65,6 +65,7 @@ def causal_attention(
     *,
     causal: bool = True,
     attn_mask: Optional[jax.Array] = None,
+    kv_lens: Optional[jax.Array] = None,
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
     deterministic: bool = True,
@@ -72,18 +73,19 @@ def causal_attention(
 ) -> jax.Array:
     """Multi-head scaled-dot-product attention, [b, s, h, d] layout.
 
-    Routes to the Pallas flash kernel when profitable (TPU, no custom mask,
-    train-time shapes); falls back to the XLA path otherwise. Attention
-    dropout runs inside the kernel (hash-based mask, see
-    fleetx_tpu/ops/pallas/flash_attention.py), so dropout>0 training configs
-    stay on the flash path. Both paths produce identical math in the
-    deterministic case (kernel is tested against this reference
-    implementation).
+    Routes to the Pallas flash kernel when profitable (TPU, train-time
+    shapes, mask expressible as causal and/or right-padding ``kv_lens``);
+    falls back to the XLA path for arbitrary ``attn_mask`` tensors or
+    decode shapes. Attention dropout runs inside the kernel (hash-based
+    mask, see fleetx_tpu/ops/pallas/flash_attention.py), so dropout>0
+    training configs stay on the flash path. Both paths produce identical
+    math in the deterministic case (kernel is tested against this
+    reference implementation). Non-causal + kv_lens covers the ERNIE-style
+    bidirectional encoder with right-padded batches.
     """
     effective_dropout = 0.0 if deterministic else dropout_rate
     can_flash = (
         use_flash
-        and causal
         and attn_mask is None
         and (effective_dropout == 0.0 or dropout_rng is not None)
         and q.shape[1] == k.shape[1]  # not incremental decode
@@ -94,7 +96,16 @@ def causal_attention(
         from fleetx_tpu.ops.pallas.flash_attention import flash_attention
 
         return flash_attention(
-            q, k, v, dropout_rate=effective_dropout, dropout_rng=dropout_rng
+            q, k, v, causal=causal, kv_lens=kv_lens,
+            dropout_rate=effective_dropout, dropout_rng=dropout_rng,
+        )
+    if kv_lens is not None:
+        key_valid = (
+            jnp.arange(k.shape[1])[None, :] < kv_lens[:, None]
+        )[:, None, None, :]  # [b, 1, 1, sk]
+        attn_mask = (
+            key_valid if attn_mask is None
+            else attn_mask.astype(bool) & key_valid
         )
     return _reference_attention(
         q,

@@ -1,4 +1,4 @@
-"""Causal flash attention — Pallas TPU kernels with custom VJP.
+"""Flash attention — Pallas TPU kernels with custom VJP.
 
 The TPU replacement for the reference's fused CUDA softmax-mask kernel +
 score-matrix attention (/root/reference/ppfleetx/models/language_model/gpt/
@@ -6,6 +6,15 @@ dygraph/single_model.py:216-240 ``core_attn`` +
 ``incubate.softmax_mask_fuse_upper_triangle``): online-softmax tiling keeps
 the [s, s] score matrix out of HBM entirely, so long sequences don't need the
 reference's ``recompute_granularity=core_attn`` memory workaround.
+
+Two masking modes, both resolved inside the kernels:
+- ``causal=True``: lower-triangular (GPT decoders); k-block scan stops at
+  the diagonal.
+- ``kv_lens`` (optional, [batch] int32): right-padding key mask — position
+  k attends only if ``k < kv_lens[b]``. This is the contiguous-padding
+  form of the reference encoder's ``attention_mask`` (ernie single_model
+  builds it from ``input_ids != pad``), so bidirectional ERNIE-style
+  encoders ride the flash path too (``causal=False`` + kv_lens).
 
 Attention dropout runs *inside* the kernel: a counter-based integer hash
 (lowbias32 finalizer) of (seed, batch*head, q_pos, k_pos) produces the keep
@@ -18,13 +27,14 @@ interpreter on CPU (where pltpu.prng_* has no lowering) and on real TPUs.
 
 Layout: q, k, v are [batch, seq, heads, head_dim] (model layout); kernels run
 per (batch*head) over q-row blocks, scanning k-column blocks up to the causal
-diagonal. fp32 accumulation, inputs any float dtype.
+diagonal (or the full row when non-causal). fp32 accumulation, inputs any
+float dtype.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import numpy as np
 
@@ -77,13 +87,23 @@ def dropout_keep_scale(seed, bh, q_pos, k_pos, rate: float):
     return keep.astype(jnp.float32) / (1.0 - rate)
 
 
-def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                *, block_k: int, scale: float, dropout_rate: float):
+def _score_mask(q_pos, k_pos, kvlen, causal: bool):
+    """Bool mask for a score tile: causal triangle ∧ key inside kv_lens."""
+    mask = k_pos < kvlen
+    if causal:
+        mask &= q_pos >= k_pos
+    return mask
+
+
+def _fwd_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                *, block_k: int, scale: float, dropout_rate: float,
+                causal: bool, seq_len: int):
     """One (batch*head, q-block) program: online softmax over k blocks."""
     bq, d = q_ref.shape
     bh = pl.program_id(0)
     i = pl.program_id(1)
     q = q_ref[:].astype(jnp.float32) * scale
+    kvlen = kvlens_ref[bh]
 
     m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
@@ -99,10 +119,13 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, block_k]
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = jnp.where(_score_mask(q_pos, k_pos, kvlen, causal), s, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
+        # fully-masked rows: keep p exactly 0 (avoids exp(NEG-NEG)=1 garbage
+        # rows feeding dV through p in the backward kernels)
+        p = jnp.where(s > NEG_INF / 2, p, 0.0)
         alpha = jnp.exp(m - m_new)
         # The softmax normalizer sums the *undropped* probabilities; dropout
         # scales only the value-weighted path (out = dropout(softmax(s)) @ v).
@@ -116,15 +139,17 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     # causal: only k blocks at or before this q block contribute
     # (block_q % block_k == 0 enforced at dispatch)
-    num_k_blocks = (i + 1) * bq // block_k
+    num_k_blocks = (i + 1) * bq // block_k if causal else seq_len // block_k
     m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
 
-    o_ref[:] = (acc / l).astype(o_ref.dtype)
-    lse_ref[:] = m + jnp.log(l)  # [bq, 1] tile of the (bh, s, 1) array
+    l_safe = jnp.where(l > 0.0, l, 1.0)  # fully-masked rows emit zeros
+    o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[:] = m + jnp.log(l_safe)  # [bq, 1] tile of the (bh, s, 1) array
 
 
-def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, *, block_k: int, scale: float, dropout_rate: float):
+def _bwd_dq_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, *, block_k: int, scale: float,
+                   dropout_rate: float, causal: bool, seq_len: int):
     bq, d = q_ref.shape
     bh = pl.program_id(0)
     i = pl.program_id(1)
@@ -132,6 +157,7 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     do = do_ref[:].astype(jnp.float32)
     lse = lse_ref[:]      # [bq, 1]
     delta = delta_ref[:]  # [bq, 1]
+    kvlen = kvlens_ref[bh]
     q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
 
     def body(j, dq):
@@ -141,8 +167,9 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)
+        mask = _score_mask(q_pos, k_pos, kvlen, causal)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -155,26 +182,27 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    num_k_blocks = (i + 1) * bq // block_k
+    num_k_blocks = (i + 1) * bq // block_k if causal else seq_len // block_k
     dq = jax.lax.fori_loop(0, num_k_blocks, body, jnp.zeros((bq, d), jnp.float32))
     dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, block_q: int, scale: float, seq_len: int,
-                    dropout_rate: float):
+def _bwd_dkv_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *, block_q: int, scale: float,
+                    seq_len: int, dropout_rate: float, causal: bool):
     bk, d = k_ref.shape
     bh = pl.program_id(0)
     j = pl.program_id(1)
     k = k_ref[:].astype(jnp.float32)
     v = v_ref[:].astype(jnp.float32)
+    kvlen = kvlens_ref[bh]
     k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    # causal: only q blocks at/after this k block see it; non-causal: all
+    first_q_block = j * bk // block_q if causal else 0
 
     def body(ii, carry):
         dk, dv = carry
-        # only q blocks at/after this k block see it (causal); iterate from
-        # the diagonal block to the end
-        i = j * bk // block_q + ii
+        i = first_q_block + ii
         q_blk = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
         do_blk = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         lse = lse_ref[pl.ds(i * block_q, block_q), :]      # [block_q, 1]
@@ -183,8 +211,9 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_blk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)
+        mask = _score_mask(q_pos, k_pos, kvlen, causal)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do_blk, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -203,7 +232,6 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         )
         return dk, dv
 
-    first_q_block = j * bk // block_q
     n_iter = seq_len // block_q - first_q_block
     dk, dv = jax.lax.fori_loop(
         0, n_iter, body, (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32))
@@ -229,16 +257,19 @@ def _seed_spec():
     return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
-def _fwd_call(seed, q3, k3, v3, block_q, block_k, scale, dropout_rate):
+def _fwd_call(seed, kvlens, q3, k3, v3, block_q, block_k, scale, dropout_rate,
+              causal):
     bh, s, d = q3.shape
     grid = (bh, s // block_q)
     kernel = functools.partial(
-        _fwd_kernel, block_k=block_k, scale=scale, dropout_rate=dropout_rate
+        _fwd_kernel, block_k=block_k, scale=scale, dropout_rate=dropout_rate,
+        causal=causal, seq_len=s,
     )
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
+            _seed_spec(),
             _seed_spec(),
             pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
@@ -255,25 +286,28 @@ def _fwd_call(seed, q3, k3, v3, block_q, block_k, scale, dropout_rate):
             jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(seed, q3, k3, v3)
+    )(seed, kvlens, q3, k3, v3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, seed, block_q, block_k, dropout_rate):
-    out, _ = _flash_fwd(q, k, v, seed, block_q, block_k, dropout_rate)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, seed, kvlens, block_q, block_k, dropout_rate, causal):
+    out, _ = _flash_fwd(q, k, v, seed, kvlens, block_q, block_k, dropout_rate,
+                        causal)
     return out
 
 
-def _flash_fwd(q, k, v, seed, block_q, block_k, dropout_rate):
+def _flash_fwd(q, k, v, seed, kvlens, block_q, block_k, dropout_rate, causal):
     b, s, h, d = q.shape
     scale = 1.0 / (d**0.5)
     q3, k3, v3 = _to_bh(q), _to_bh(k), _to_bh(v)
-    o3, lse = _fwd_call(seed, q3, k3, v3, block_q, block_k, scale, dropout_rate)
-    return _from_bh(o3, b, h), (q3, k3, v3, o3, lse, seed, b, h)
+    o3, lse = _fwd_call(
+        seed, kvlens, q3, k3, v3, block_q, block_k, scale, dropout_rate, causal
+    )
+    return _from_bh(o3, b, h), (q3, k3, v3, o3, lse, seed, kvlens, b, h)
 
 
-def _flash_bwd(block_q, block_k, dropout_rate, res, g):
-    q3, k3, v3, o3, lse, seed, b, h = res
+def _flash_bwd(block_q, block_k, dropout_rate, causal, res, g):
+    q3, k3, v3, o3, lse, seed, kvlens, b, h = res
     bh, s, d = q3.shape
     scale = 1.0 / (d**0.5)
     do3 = _to_bh(g)
@@ -282,10 +316,12 @@ def _flash_bwd(block_q, block_k, dropout_rate, res, g):
 
     dq3 = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, block_k=block_k, scale=scale, dropout_rate=dropout_rate
+            _bwd_dq_kernel, block_k=block_k, scale=scale,
+            dropout_rate=dropout_rate, causal=causal, seq_len=s,
         ),
         grid=(bh, s // block_q),
         in_specs=[
+            _seed_spec(),
             _seed_spec(),
             pl.BlockSpec((None, block_q, d), lambda b_, i: (b_, i, 0)),
             pl.BlockSpec((None, s, d), lambda b_, i: (b_, 0, 0)),
@@ -297,15 +333,16 @@ def _flash_bwd(block_q, block_k, dropout_rate, res, g):
         out_specs=pl.BlockSpec((None, block_q, d), lambda b_, i: (b_, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
         interpret=_interpret(),
-    )(seed, q3, k3, v3, do3, lse, delta)
+    )(seed, kvlens, q3, k3, v3, do3, lse, delta)
 
     dk3, dv3 = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, block_q=block_q, scale=scale, seq_len=s,
-            dropout_rate=dropout_rate,
+            dropout_rate=dropout_rate, causal=causal,
         ),
         grid=(bh, s // block_k),
         in_specs=[
+            _seed_spec(),
             _seed_spec(),
             pl.BlockSpec((None, s, d), lambda b_, j: (b_, 0, 0)),
             pl.BlockSpec((None, block_k, d), lambda b_, j: (b_, j, 0)),
@@ -323,14 +360,15 @@ def _flash_bwd(block_q, block_k, dropout_rate, res, g):
             jax.ShapeDtypeStruct((bh, s, d), v3.dtype),
         ],
         interpret=_interpret(),
-    )(seed, q3, k3, v3, do3, lse, delta)
+    )(seed, kvlens, q3, k3, v3, do3, lse, delta)
 
     dq = _from_bh(dq3, b, h)
     dk = _from_bh(dk3, b, h)
     dv = _from_bh(dv3, b, h)
-    # seed is integer-dtype: its cotangent type is float0
+    # seed/kvlens are integer-dtype: their cotangent type is float0
     dseed = np.zeros(seed.shape, dtype=jax.dtypes.float0)
-    return dq, dk, dv, dseed
+    dkvlens = np.zeros(kvlens.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dseed, dkvlens
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -343,14 +381,18 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     *,
+    causal: bool = True,
+    kv_lens: Optional[jax.Array] = None,
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Causal flash attention, [b, s, h, d] layout. Sequence length must be a
+    """Flash attention, [b, s, h, d] layout. Sequence length must be a
     multiple of the block sizes (callers fall back to the XLA path
-    otherwise — fleetx_tpu/ops/attention.py). ``dropout_rate > 0`` requires a
+    otherwise — fleetx_tpu/ops/attention.py). ``kv_lens`` [b] int32 masks
+    right-padded keys (position k valid iff k < kv_lens[b]); ``causal=False``
+    gives bidirectional (encoder) attention. ``dropout_rate > 0`` requires a
     ``dropout_rng`` key; the mask is generated inside the kernel."""
-    s = q.shape[1]
+    b, s, h, _ = q.shape
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if s % block_q or s % block_k or block_q % block_k:
@@ -361,4 +403,9 @@ def flash_attention(
         seed = jax.random.bits(dropout_rng, (1,), "uint32").astype(jnp.int32)
     else:
         seed = jnp.zeros((1,), jnp.int32)
-    return _flash(q, k, v, seed, block_q, block_k, float(dropout_rate))
+    if kv_lens is None:
+        kvlens_bh = jnp.full((b * h,), s, jnp.int32)
+    else:
+        kvlens_bh = jnp.repeat(kv_lens.astype(jnp.int32), h)  # [b*h]
+    return _flash(q, k, v, seed, kvlens_bh, block_q, block_k,
+                  float(dropout_rate), bool(causal))
