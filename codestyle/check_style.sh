@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Style gate (reference codestyle/: pylint docstring plugin + clang-format +
+# cpplint pre-commit hooks). Dependency-free equivalents; native linters run
+# only when present on the machine.
+set -u
+cd "$(dirname "$0")/.."
+fail=0
+
+echo "== syntax (compileall) =="
+python -m compileall -q fleetx_tpu tools tasks || fail=1
+
+echo "== docstring coverage =="
+python codestyle/docstring_checker.py fleetx_tpu || fail=1
+
+echo "== whitespace =="
+if grep -rn --include='*.py' -P ' +$' fleetx_tpu tools tasks | head -5 | grep .; then
+    echo "trailing whitespace found"; fail=1
+fi
+if grep -rln --include='*.py' -P '\t' fleetx_tpu | head -5 | grep .; then
+    echo "hard tabs found in python sources"; fail=1
+fi
+
+if command -v clang-format > /dev/null; then
+    echo "== clang-format (C++ diff check) =="
+    for f in $(find fleetx_tpu -name '*.cpp' -o -name '*.h'); do
+        if ! diff -q <(clang-format "$f") "$f" > /dev/null; then
+            echo "$f needs clang-format"; fail=1
+        fi
+    done
+fi
+
+[ "$fail" -eq 0 ] && echo "style OK"
+exit $fail

@@ -1,0 +1,1 @@
+"""Core runtime: Trainer engine, InferenceEngine (reference ppfleetx/core)."""

@@ -39,6 +39,8 @@ __all__ = ["Trainer", "TrainState"]
 
 
 class TrainState(struct.PyTreeNode):
+    """step + params + optimizer state (+ module extra state), the pytree
+    threaded through the jitted train step."""
     step: jax.Array
     params: Any
     opt_state: Any
@@ -139,6 +141,9 @@ def _rebox_like(raw_tree, boxed_tree):
 
 
 class Trainer:
+    """The engine: builds mesh/shardings/optimizer, compiles the
+    train/eval/predict steps, owns fit/evaluate/save/load (see module
+    docstring)."""
     def __init__(self, cfg, module: BasicModule, mode: str = "train"):
         self.cfg = cfg
         self.module = module

@@ -22,6 +22,8 @@ __all__ = ["InferenceEngine"]
 
 
 class InferenceEngine:
+    """Serves an export artifact: rebuilds the module, restores params,
+    jit-compiles forward/generate (see module docstring)."""
     def __init__(self, export_dir: str, mesh=None):
         self.cfg, self.params, self.input_spec = load_exported(export_dir)
         model_cfg = self.cfg.get("Model") or {}

@@ -10,6 +10,7 @@ _BUILTINS_LOADED = False
 
 
 def register_dataset(name):
+    """Class decorator adding a dataset to the build_dataset registry."""
     def deco(cls):
         _DATASETS[name] = cls
         return cls
@@ -48,6 +49,7 @@ def _dataset_registry():
 
 
 def build_dataset(ds_cfg, mode: str = "Train", **extra):
+    """Instantiate the dataset named by the Data.<mode>.dataset config node."""
     registry = _dataset_registry()
     kwargs = dict(ds_cfg)
     name = kwargs.pop("name")

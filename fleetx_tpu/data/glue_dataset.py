@@ -53,6 +53,8 @@ GLUE_TASKS = {
 
 
 class GlueDataset:
+    """GLUE task dataset: TSV parsing per task spec with synthetic fallback
+    (reference glue_dataset.py)."""
     def __init__(
         self,
         task: str,

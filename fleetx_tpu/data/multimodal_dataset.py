@@ -19,6 +19,8 @@ __all__ = ["TextImageDataset"]
 
 
 class TextImageDataset:
+    """Imagen text-image pairs: mmap images + precomputed text embeddings (see
+    module docstring for the on-disk layout)."""
     def __init__(self, input_dir=None, image_size: int = 64, mode="Train",
                  seed: int = 1234, num_samples: Optional[int] = None,
                  synthetic: bool = False, max_text_len: int = 64,

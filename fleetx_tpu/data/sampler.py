@@ -19,6 +19,8 @@ __all__ = ["GPTBatchSampler", "DistributedBatchSampler"]
 
 
 class GPTBatchSampler:
+    """Distributed batch sampler with consumed_samples resume (reference
+    batch_sampler.py:31)."""
     def __init__(
         self,
         dataset_len: int,

@@ -1,0 +1,1 @@
+"""Tokenizers: GPT byte-level BPE, ERNIE WordPiece (reference data/tokenizers)."""

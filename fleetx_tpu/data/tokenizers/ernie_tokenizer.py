@@ -33,6 +33,8 @@ def _is_cjk(cp: int) -> bool:
 
 
 class ErnieTokenizer:
+    """WordPiece tokenizer over an ERNIE vocab.txt (reference paddlenlp
+    ErnieTokenizer surface)."""
     cls_token = "[CLS]"
     sep_token = "[SEP]"
     mask_token = "[MASK]"

@@ -47,6 +47,8 @@ def _bytes_to_unicode() -> Dict[int, str]:
 
 
 class GPTTokenizer:
+    """Byte-level BPE tokenizer (GPT-2 vocab/merges files, reference
+    gpt_tokenizer.py:91)."""
     eos_token = "<|endoftext|>"
 
     def __init__(self, vocab_file: str, merges_file: str, errors: str = "replace"):

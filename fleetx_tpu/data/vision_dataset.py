@@ -64,6 +64,8 @@ def _center_crop(img, out_size):
 
 
 class GeneralClsDataset:
+    """Classification dataset over mmap .npz images with numpy augmentations
+    (reference vision_dataset.py)."""
     def __init__(
         self,
         input_dir: str,

@@ -8,6 +8,7 @@ _REGISTRY = {}
 
 
 def register_module(name):
+    """Class decorator adding a Module to the build_module registry."""
     def deco(cls):
         _REGISTRY[name] = cls
         return cls
@@ -16,6 +17,8 @@ def register_module(name):
 
 
 def build_module(cfg):
+    """Instantiate the Module named by cfg.Model.module (reference
+    models/__init__.py:30-34)."""
     name = cfg.Model.module
     module_cls = _get(name)
     return module_cls(cfg)

@@ -1,3 +1,5 @@
+"""ERNIE encoder family (reference models/language_model/ernie)."""
+
 from fleetx_tpu.models.ernie.model import (  # noqa: F401
     ErnieConfig,
     ErnieModel,

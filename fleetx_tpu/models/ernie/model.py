@@ -48,6 +48,8 @@ __all__ = [
 
 @dataclasses.dataclass(frozen=True)
 class ErnieConfig:
+    """ERNIE encoder hyperparameters (reference ernie single_model.py
+    construction args)."""
     vocab_size: int = 40000
     hidden_size: int = 768
     num_layers: int = 12

@@ -27,6 +27,8 @@ __all__ = ["ErnieModule"]
 
 
 class ErnieModule(LanguageModule):
+    """ERNIE pretraining: masked-LM + sentence-order-prediction losses
+    (reference ernie_module.py:69-121)."""
     def get_model(self):
         model_cfg = self.cfg.Model if hasattr(self.cfg, "Model") else self.cfg
         ecfg = ErnieConfig.from_model_config(model_cfg)

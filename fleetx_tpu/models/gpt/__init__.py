@@ -1,0 +1,1 @@
+"""GPT decoder family: model, generation, beam search (reference models/language_model/gpt)."""

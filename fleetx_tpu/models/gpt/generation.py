@@ -22,6 +22,8 @@ __all__ = ["GenerationConfig", "generate", "process_logits"]
 
 @dataclasses.dataclass(frozen=True)
 class GenerationConfig:
+    """Decode-strategy knobs (reference GPTForGeneration config surface:
+    top-k/p, beams, penalties, forced tokens)."""
     max_length: int = 64  # new tokens to generate
     min_length: int = 0
     decode_strategy: str = "sampling"  # 'greedy' | 'sampling' | 'beam_search'

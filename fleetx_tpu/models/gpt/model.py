@@ -40,6 +40,8 @@ default_kernel_init = nn.initializers.normal(stddev=0.02)
 
 @dataclasses.dataclass(frozen=True)
 class GPTConfig:
+    """GPT model hyperparameters incl. parallel/remat/flash switches
+    (reference GPTModel construction args)."""
     vocab_size: int = 50304
     hidden_size: int = 1024
     num_layers: int = 24

@@ -32,6 +32,8 @@ __all__ = ["ImagenModule"]
 
 
 class ImagenModule(BasicModule):
+    """Imagen diffusion training module: UNet denoiser + cosine log-SNR
+    schedule over precomputed text embeddings."""
     def get_model(self):
         model_cfg = self.cfg.Model if hasattr(self.cfg, "Model") else self.cfg
         eng = getattr(self.cfg, "Engine", None) or {}

@@ -15,6 +15,8 @@ __all__ = ["GPTGenerationModule"]
 
 
 class GPTGenerationModule(GPTModule):
+    """Serving module for decode: wraps GPTModel with the sampling/beam
+    generation stack (reference language_module.py:484-585)."""
     def __init__(self, cfg):
         super().__init__(cfg)
         self.generation_cfg = GenerationConfig.from_config(cfg.get("Generation"))

@@ -22,6 +22,7 @@ def _to_pred_labels(preds: np.ndarray) -> np.ndarray:
 
 
 class Accuracy:
+    """Top-1 accuracy accumulator (reference metrics.py Accuracy)."""
     def __init__(self, **_):
         self.reset()
 
@@ -197,6 +198,7 @@ _METRICS = {
 
 
 def build_metric(cfg):
+    """Metric factory by config name (reference GLUE metric selection)."""
     cfg = dict(cfg or {})
     name = cfg.pop("name", "Accuracy")
     if name not in _METRICS:

@@ -34,6 +34,8 @@ __all__ = ["MOCOModule", "MOCOClsModule"]
 
 
 class MOCOModule(BasicModule):
+    """MoCo v1/v2 pretraining: InfoNCE over a momentum encoder + negative
+    queue kept in TrainState.extra (reference moco_module.py)."""
     def get_model(self):
         model_cfg = self.cfg.Model if hasattr(self.cfg, "Model") else self.cfg
         self.dim = int(model_cfg.get("dim") or 128)
@@ -180,6 +182,9 @@ class MOCOClsModule(BasicModule):
             resnet_kw["width"] = int(model_cfg["width"])
 
         class LinearProbe(nn.Module):
+            """Frozen-backbone linear classifier for MoCo evaluation
+            (reference MOCOClsModule)."""
+
             @nn.compact
             def __call__(self, images):
                 h = build_resnet(backbone, num_classes=0, dtype=dtype,

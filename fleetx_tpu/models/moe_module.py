@@ -16,6 +16,8 @@ __all__ = ["MoEModule"]
 
 
 class MoEModule(GPTModule):
+    """GPT + mixture-of-experts FFN pretraining: adds the gate balance loss to
+    the LM loss (reference language_module.py:704-819)."""
     def loss_fn(self, params, batch, rng, train: bool):
         tokens, position_ids, labels, loss_mask = self.cp_prepare(batch)
         logits, mutated = self.nets.apply(

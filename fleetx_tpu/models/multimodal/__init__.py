@@ -1,3 +1,5 @@
+"""Imagen text-to-image diffusion family (reference models/multimodal_model)."""
+
 from fleetx_tpu.models.multimodal.unet import (  # noqa: F401
     EfficientUNet,
     UNetConfig,

@@ -28,6 +28,8 @@ def cosine_log_snr(t, s: float = 0.008):
 
 
 def log_snr_to_alpha_sigma(log_snr):
+    """Cosine-schedule helpers: log-SNR -> (alpha, sigma) diffusion
+    coefficients."""
     alpha = jnp.sqrt(jax.nn.sigmoid(log_snr))
     sigma = jnp.sqrt(jax.nn.sigmoid(-log_snr))
     return alpha, sigma

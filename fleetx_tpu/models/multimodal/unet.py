@@ -31,6 +31,8 @@ __all__ = ["UNetConfig", "EfficientUNet", "UNET_PRESETS", "build_unet"]
 
 @dataclasses.dataclass(frozen=True)
 class UNetConfig:
+    """Efficient-UNet shape/conditioning hyperparameters (reference
+    imagen/unet.py presets)."""
     dim: int = 128
     dim_mults: Tuple[int, ...] = (1, 2, 3, 4)
     num_resnet_blocks: Union[int, Tuple[int, ...]] = 2
@@ -88,6 +90,8 @@ UNET_PRESETS = {
 
 
 def build_unet(name: str, **overrides) -> "EfficientUNet":
+    """UNet preset factory by name (Unet64_397M / BaseUnet64 / SRUnet256 /
+    SRUnet1024)."""
     if name not in UNET_PRESETS:
         raise ValueError(f"unknown unet {name!r}; have {sorted(UNET_PRESETS)}")
     return EfficientUNet(UNetConfig(**{**UNET_PRESETS[name], **overrides}))

@@ -1,3 +1,5 @@
+"""Protein-folding trunk (Evoformer, templates, geometry; reference models/protein_folding)."""
+
 from fleetx_tpu.models.protein.evoformer import (  # noqa: F401
     EvoformerConfig,
     EvoformerIteration,

@@ -51,6 +51,8 @@ BIG_NEG = -1e9
 
 @dataclasses.dataclass(frozen=True)
 class EvoformerConfig:
+    """Evoformer stack hyperparameters (msa/pair channels, heads, block
+    counts)."""
     msa_channel: int = 256
     pair_channel: int = 128
     num_heads_msa: int = 8

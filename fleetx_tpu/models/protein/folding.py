@@ -45,6 +45,8 @@ EXTRA_MSA_FEAT_DIM = 25  # 23 one-hot + has_deletion + deletion_value
 
 @dataclasses.dataclass(frozen=True)
 class FoldingConfig:
+    """Hyperparameters of the full folding trunk (msa/pair dims, template +
+    extra-MSA stacks)."""
     msa_channel: int = 256
     pair_channel: int = 128
     seq_channel: int = 384
@@ -98,6 +100,8 @@ class FoldingConfig:
 
 
 class DistEmbeddingsAndEvoformer(nn.Module):
+    """Input embeddings + template + extra-MSA + Evoformer composition
+    (reference evoformer.py:484-859), DAP-sharded over the cp axis."""
     cfg: FoldingConfig
 
     @nn.compact

@@ -46,6 +46,7 @@ def rigids_from_3_points(point_on_neg_x_axis, origin, point_on_xy_plane,
 
 
 def invert_rigid(rot, trans):
+    """Inverse rigid transform: (R, t) -> (R^T, -R^T t)."""
     inv_rot = jnp.swapaxes(rot, -1, -2)
     inv_trans = -jnp.einsum("...ij,...j->...i", inv_rot, trans)
     return inv_rot, inv_trans

@@ -37,6 +37,7 @@ BIG_NEG = -1e9
 
 @dataclasses.dataclass(frozen=True)
 class TemplateConfig:
+    """Template-embedding hyperparameters (reference template.py)."""
     enabled: bool = True
     embed_torsion_angles: bool = True
     use_template_unit_vector: bool = False

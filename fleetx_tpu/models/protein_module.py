@@ -60,6 +60,8 @@ class _TrunkWithHeads(nn.Module):
 
 @register_module("ProteinFoldingModule")
 class ProteinFoldingModule(BasicModule):
+    """Folding-trunk training module: masked-MSA BERT loss over the Evoformer
+    stack with DAP sharding."""
     def get_model(self):
         model_cfg = self.cfg.Model
         eng = getattr(self.cfg, "Engine", None) or {}

@@ -1,3 +1,5 @@
+"""Vision backbones: ViT presets, ResNet for MoCo (reference models/vision_model)."""
+
 from fleetx_tpu.models.vision.vit import (  # noqa: F401
     ViT,
     ViTConfig,

@@ -22,6 +22,7 @@ Dtype = Any
 
 @dataclasses.dataclass(frozen=True)
 class ResNetConfig:
+    """ResNet depth/width hyperparameters for the MoCo backbone."""
     stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)
     bottleneck: bool = True
     width: int = 64
@@ -110,6 +111,7 @@ class ResNet(nn.Module):
 
 
 def build_resnet(name: str, **overrides) -> ResNet:
+    """ResNet factory by depth name (resnet50 etc.)."""
     if name not in RESNET_PRESETS:
         raise ValueError(f"unknown resnet {name!r}; have {sorted(RESNET_PRESETS)}")
     return ResNet(ResNetConfig(**{**RESNET_PRESETS[name], **overrides}))

@@ -36,6 +36,7 @@ __all__ = ["ViTConfig", "ViT", "VIT_PRESETS", "build_vision_model"]
 
 @dataclasses.dataclass(frozen=True)
 class ViTConfig:
+    """ViT backbone hyperparameters (reference vit.py presets)."""
     image_size: int = 224
     patch_size: int = 16
     in_channels: int = 3
@@ -107,6 +108,8 @@ class DropPath(nn.Module):
 
 
 class ViTBlock(nn.Module):
+    """Pre-LN transformer encoder block with droppath (reference
+    vision_model/layers)."""
     cfg: ViTConfig
     drop_path: float = 0.0
 

@@ -1,0 +1,1 @@
+"""Core tensor ops: attention dispatch, Pallas kernels, quantization."""

@@ -42,6 +42,7 @@ def quantize_int8(w: jax.Array, axis: int = -1):
 
 
 def dequantize_int8(q: jax.Array, scale: jax.Array, dtype=jnp.float32):
+    """Inverse of quantize_int8: int8 values x per-channel scales -> float."""
     return (q.astype(jnp.float32) * scale).astype(dtype)
 
 

@@ -1,0 +1,1 @@
+"""Optimizer + LR-schedule + grad-clip builders (reference ppfleetx/optims)."""

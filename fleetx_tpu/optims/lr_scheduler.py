@@ -74,6 +74,8 @@ def ViTLRScheduler(
     decay_type: str = "cosine",
     **_,
 ) -> optax.Schedule:
+    """Linear-warmup + cosine decay used by the ViT configs (reference
+    optims/lr_scheduler.py:88)."""
     total = epochs * step_each_epoch
     warmup_steps = warmup_epochs * step_each_epoch
 
@@ -96,6 +98,8 @@ def MultiStepDecay(
     gamma: float = 0.1,
     **_,
 ) -> optax.Schedule:
+    """Piecewise-constant decay at milestone steps (reference
+    lr_scheduler.py:129)."""
     def schedule(step):
         step = jnp.asarray(step, jnp.float32)
         exponent = jnp.sum(
@@ -112,6 +116,8 @@ def CosineDecay(
     alpha: float = 0.0,
     **_,
 ) -> optax.Schedule:
+    """Plain cosine decay to zero over decay_steps (reference
+    lr_scheduler.py:147)."""
     def schedule(step):
         frac = jnp.clip(jnp.asarray(step, jnp.float32) / decay_steps, 0.0, 1.0)
         coeff = 0.5 * (1.0 + jnp.cos(jnp.pi * frac))

@@ -1,0 +1,1 @@
+"""Parallelism layer: mesh, sharding rules, pipeline/MoE/context-parallel, DAP."""

@@ -82,12 +82,14 @@ def set_seed(seed: int) -> jax.Array:
 
 
 def root_key() -> jax.Array:
+    """The process-wide root PRNG key set by set_seed()."""
     if _ROOT_KEY is None:
         raise RuntimeError("call set_seed() first")
     return _ROOT_KEY
 
 
 def global_seed() -> int:
+    """The integer seed set_seed() was called with."""
     if _GLOBAL_SEED is None:
         raise RuntimeError("call set_seed() first")
     return _GLOBAL_SEED

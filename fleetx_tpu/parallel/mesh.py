@@ -46,6 +46,8 @@ DATA_AXES = ("dp", "fsdp")
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
+    """Parallel-degree tuple (pp/dp/fsdp/cp/mp + sharding stage/offload)
+    parsed from the Distributed config section."""
     dp: int = 1
     fsdp: int = 1
     mp: int = 1

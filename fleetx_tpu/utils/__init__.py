@@ -1,0 +1,1 @@
+"""Config system, logger, export, profiler summaries (reference ppfleetx/utils)."""

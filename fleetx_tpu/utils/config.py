@@ -59,6 +59,7 @@ class AttrDict(dict):
 
 
 def create_attr_dict(d: dict) -> AttrDict:
+    """Recursively convert nested dicts into AttrDicts in place."""
     out = AttrDict()
     for k, v in d.items():
         if k == "_inherited_":  # inheritance marker, never part of the config
@@ -260,6 +261,8 @@ def process_engine_config(cfg: AttrDict) -> AttrDict:
 
 
 def process_configs(cfg: AttrDict, nranks: Optional[int] = None) -> AttrDict:
+    """Run all normalization passes (dist degrees, batch algebra, engine
+    defaults) on a parsed config."""
     process_dist_config(cfg, nranks=nranks)
     process_global_configs(cfg)
     process_engine_config(cfg)
@@ -282,6 +285,7 @@ def get_config(
 
 
 def print_config(cfg: dict, indent: int = 0) -> None:
+    """Pretty-print the config tree via the logger."""
     for k, v in cfg.items():
         if isinstance(v, dict):
             logger.info("%s%s:", "  " * indent, k)
@@ -291,6 +295,8 @@ def print_config(cfg: dict, indent: int = 0) -> None:
 
 
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    """Standard CLI surface: -c/--config plus repeatable -o dot-path overrides
+    (reference utils/config.py parse_args)."""
     parser = argparse.ArgumentParser("fleetx-tpu runner")
     parser.add_argument("-c", "--config", required=True, help="config YAML path")
     parser.add_argument(

@@ -90,10 +90,12 @@ def only_primary(fn):
 
 
 def get_timestamp() -> str:
+    """Filesystem-safe timestamp string (reference log.py:181)."""
     return time.strftime("%Y%m%d_%H%M%S", time.localtime())
 
 
 def advertise() -> None:
+    """Startup banner (reference log.py:153)."""
     logger.info("=" * 64)
     logger.info("fleetx-tpu — TPU-native large-model toolkit (JAX/XLA/Pallas)")
     logger.info("=" * 64)
