@@ -1,0 +1,20 @@
+"""Auto-parallel entrypoint (reference /root/reference/tools/auto.py ->
+AutoEngine over fleet.auto.Engine).
+
+In this framework GSPMD sharding IS the auto-parallel engine — the standard
+Trainer compiles one jitted step whose layouts come from logical-axis rules,
+which is exactly the "annotate + let the compiler place collectives" model
+the reference's auto stack approximates. So this driver is the same training
+flow as tools/train.py, kept as a separate entrypoint so reference launch
+scripts (`python ./tools/auto.py -c configs/nlp/gpt/auto/...`) run unchanged.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from train import main  # noqa: E402  (same flow, auto configs resolve via _base_)
+
+if __name__ == "__main__":
+    main()

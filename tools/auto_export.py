@@ -1,0 +1,17 @@
+"""Auto-parallel export entrypoint (reference /root/reference/tools/
+auto_export.py -> AutoEngine.export / export_from_prog).
+
+Same unification as tools/auto.py: the GSPMD stack has one export path
+(StableHLO + orbax artifact, fleetx_tpu/utils/export.py), so this driver
+reuses tools/export.py under the reference's auto CLI name.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from export import main  # noqa: E402
+
+if __name__ == "__main__":
+    main()
