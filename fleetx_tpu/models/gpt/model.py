@@ -475,6 +475,52 @@ class GPTForSequenceClassification(nn.Module):
                       dtype=jnp.float32, use_bias=False)(pooled.astype(jnp.float32))
 
 
+def convert_qkv_layout(gpt_params: dict, to_fused: bool) -> dict:
+    """Convert attention projection params between the fused single-matmul
+    layout (``qkv_proj``: kernel [..., embed, heads, 3*kv]) and the split
+    layout (``q_proj``/``k_proj``/``v_proj``: kernel [..., embed, heads, kv])
+    — the reference's finetune checkpoint converter
+    (/root/reference/ppfleetx/models/language_model/language_module.py:
+    293-372 ``process_qkv_weight``). Pure tree rewrite; works on raw arrays
+    (callers unbox first) at any nesting depth, including scan-stacked
+    [num_layers, ...] leaves."""
+    import numpy as _np
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            if k == "qkv_proj" and not to_fused and isinstance(v, dict):
+                kern, bias = v.get("kernel"), v.get("bias")
+                for idx, name in enumerate(("q_proj", "k_proj", "v_proj")):
+                    part = {}
+                    if kern is not None:
+                        part["kernel"] = _np.array_split(_np.asarray(kern), 3, axis=-1)[idx]
+                    if bias is not None:
+                        part["bias"] = _np.array_split(_np.asarray(bias), 3, axis=-1)[idx]
+                    out[name] = part
+            elif k == "q_proj" and to_fused and isinstance(v, dict):
+                parts = [node[n] for n in ("q_proj", "k_proj", "v_proj")]
+                fused = {}
+                if parts[0].get("kernel") is not None:
+                    fused["kernel"] = _np.concatenate(
+                        [_np.asarray(pp["kernel"]) for pp in parts], axis=-1
+                    )
+                if parts[0].get("bias") is not None:
+                    fused["bias"] = _np.concatenate(
+                        [_np.asarray(pp["bias"]) for pp in parts], axis=-1
+                    )
+                out["qkv_proj"] = fused
+            elif k in ("k_proj", "v_proj") and to_fused:
+                continue  # folded into qkv_proj above
+            else:
+                out[k] = walk(v)
+        return out
+
+    return walk(gpt_params)
+
+
 def pretraining_loss(logits: jax.Array, labels: jax.Array, loss_mask: jax.Array):
     """Masked LM cross-entropy (reference GPTPretrainingCriterion,
     single_model.py:702-736; the TP ParallelCrossEntropy variant

@@ -53,6 +53,11 @@ def LinearDecayWithWarmup(
 ) -> optax.Schedule:
     """Linear warmup (fraction ``warmup`` of total) then linear decay to 0."""
     lr = max_lr if learning_rate is None else learning_rate
+    if total_steps is None:
+        raise ValueError(
+            "LinearDecayWithWarmup needs Optimizer.lr.total_steps "
+            "(reference GLUE configs set it to epochs * steps_per_epoch)"
+        )
     warmup_steps = int(warmup * total_steps) if warmup < 1 else int(warmup)
 
     def schedule(step):

@@ -1,0 +1,144 @@
+"""Pretrained-backbone loading for finetune: export a fused-qkv GPT, load it
+into a split-qkv finetune module (reference's fused/split checkpoint
+conversion, language_module.py:293-372)."""
+
+import textwrap
+
+import jax
+import numpy as np
+import pytest
+
+from fleetx_tpu.core.engine import Trainer, _unbox
+from fleetx_tpu.models import build_module
+from fleetx_tpu.utils.config import get_config
+
+
+def _pretrain_export(tmp_path):
+    text = textwrap.dedent(
+        """
+        Global:
+          seed: 7
+          local_batch_size: 2
+          micro_batch_size: 2
+        Engine:
+          max_steps: 1
+          save_load:
+            save_steps: 1000
+        Model:
+          module: GPTModule
+          vocab_size: 96
+          hidden_size: 32
+          num_layers: 2
+          num_attention_heads: 2
+          ffn_hidden_size: 64
+          max_position_embeddings: 16
+          hidden_dropout_prob: 0.0
+          attention_probs_dropout_prob: 0.0
+          use_flash_attention: False
+          fuse_attn_qkv: True
+        Optimizer:
+          name: AdamW
+          lr:
+            name: CosineAnnealingWithWarmupDecay
+            decay_steps: 10
+            max_lr: 1.0e-3
+            min_lr: 1.0e-4
+        """
+    )
+    p = tmp_path / "pre.yaml"
+    p.write_text(text)
+    cfg = get_config(str(p), nranks=1)
+    cfg.Engine.save_load.output_dir = str(tmp_path / "pre_out")
+    module = build_module(cfg)
+    trainer = Trainer(cfg, module)
+    rng = np.random.RandomState(0)
+    batch = {
+        "tokens": rng.randint(0, 96, (2, 16)).astype(np.int32),
+        "labels": rng.randint(0, 96, (2, 16)).astype(np.int32),
+        "loss_mask": np.ones((2, 16), np.float32),
+    }
+    trainer.init_state(batch)
+    from fleetx_tpu.utils.export import export_inference_model
+
+    out = str(tmp_path / "exported")
+    export_inference_model(module, trainer.state.params, out)
+    return out, jax.tree.map(np.asarray, _unbox(trainer.state.params))
+
+
+@pytest.mark.parametrize("fuse", [False, True])
+def test_finetune_loads_pretrained_with_qkv_conversion(tmp_path, eight_devices, fuse):
+    export_dir, src = _pretrain_export(tmp_path)
+    text = textwrap.dedent(
+        f"""
+        Global:
+          seed: 11
+          local_batch_size: 2
+          micro_batch_size: 2
+        Engine:
+          max_steps: 1
+          save_load:
+            save_steps: 1000
+        Model:
+          module: GPTFinetuneModule
+          pretrained: {export_dir}
+          num_classes: 3
+          vocab_size: 96
+          hidden_size: 32
+          num_layers: 2
+          num_attention_heads: 2
+          ffn_hidden_size: 64
+          max_position_embeddings: 16
+          hidden_dropout_prob: 0.0
+          attention_probs_dropout_prob: 0.0
+          use_flash_attention: False
+          fuse_attn_qkv: {fuse}
+        Optimizer:
+          name: AdamW
+          lr:
+            name: LinearDecayWithWarmup
+            warmup: 0.1
+            total_steps: 100
+            max_lr: 1.0e-4
+        """
+    )
+    p = tmp_path / "ft.yaml"
+    p.write_text(text)
+    cfg = get_config(str(p), nranks=1)
+    cfg.Engine.save_load.output_dir = str(tmp_path / f"ft_out_{fuse}")
+    module = build_module(cfg)
+    trainer = Trainer(cfg, module)
+    batch = {
+        "tokens": np.zeros((2, 16), np.int32),
+        "seq_lens": np.full((2,), 16, np.int32),
+        "labels": np.zeros((2,), np.int32),
+    }
+    trainer.init_state(batch)
+    ft = jax.tree.map(np.asarray, _unbox(trainer.state.params))
+
+    # backbone transferred exactly
+    np.testing.assert_array_equal(
+        ft["gpt"]["word_embeddings"], src["gpt"]["word_embeddings"]
+    )
+    src_attn = src["gpt"]["layers"]["layer"]["attn"]
+    ft_attn = ft["gpt"]["layers"]["layer"]["attn"]
+    if fuse:
+        np.testing.assert_array_equal(
+            ft_attn["qkv_proj"]["kernel"], src_attn["qkv_proj"]["kernel"]
+        )
+    else:
+        q, k, v = np.array_split(src_attn["qkv_proj"]["kernel"], 3, axis=-1)
+        np.testing.assert_array_equal(ft_attn["q_proj"]["kernel"], q)
+        np.testing.assert_array_equal(ft_attn["k_proj"]["kernel"], k)
+        np.testing.assert_array_equal(ft_attn["v_proj"]["kernel"], v)
+        qb, kb, vb = np.array_split(src_attn["qkv_proj"]["bias"], 3, axis=-1)
+        np.testing.assert_array_equal(ft_attn["q_proj"]["bias"], qb)
+        np.testing.assert_array_equal(ft_attn["v_proj"]["bias"], vb)
+
+    # the head has no pretrained counterpart: fresh init, trainable step runs
+    assert "score" in ft
+    import fleetx_tpu.parallel.env as dist_env
+
+    step = trainer._get("train", trainer._build_train_step)
+    db = trainer._shard_batch(batch)
+    _, metrics = step(trainer.state, db, dist_env.data_rank_key(0))
+    assert np.isfinite(float(metrics["loss"]))
