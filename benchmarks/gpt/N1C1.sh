@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+# Single-chip benchmark case (reference test_tipc N1C1 entry).
+cd "$(dirname "$0")/../.."
+python tools/bench_matrix.py --devices 1 --out "${1:-bench_n1c1.json}"

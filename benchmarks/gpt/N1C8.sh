@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# 8-device topology grid (reference test_tipc N1C8 entries; virtual CPU
+# mesh when no 8-chip TPU is attached).
+cd "$(dirname "$0")/../.."
+if ! python -c "import jax; assert jax.device_count() >= 8" 2>/dev/null; then
+    export JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
+fi
+python tools/bench_matrix.py --devices 8 --out "${1:-bench_n1c8.json}"
