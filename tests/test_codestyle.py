@@ -1,0 +1,56 @@
+"""The style gate's own tests (reference codestyle/test_docstring_checker.py)."""
+
+import subprocess
+import sys
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+def _run_checker(tmp_path, source, *args):
+    f = tmp_path / "mod.py"
+    f.write_text(source)
+    return subprocess.run(
+        [sys.executable, f"{REPO}/codestyle/docstring_checker.py", str(f), *args],
+        capture_output=True, text=True,
+    )
+
+
+def test_flags_missing_docstrings(tmp_path):
+    r = _run_checker(
+        tmp_path,
+        "class Thing:\n    pass\n\ndef func():\n    pass\n",
+    )
+    assert r.returncode == 1
+    assert "module docstring missing" in r.stdout
+    assert "class Thing" in r.stdout
+    assert "def func" in r.stdout
+
+
+def test_passes_documented_module(tmp_path):
+    r = _run_checker(
+        tmp_path,
+        '"""Module."""\n\nclass Thing:\n    """Doc."""\n\n'
+        'def func():\n    """Doc."""\n',
+    )
+    assert r.returncode == 0, r.stdout
+
+
+def test_private_and_methods_exempt_unless_strict(tmp_path):
+    src = (
+        '"""Module."""\n\nclass Thing:\n    """Doc."""\n'
+        "    def method(self):\n        pass\n\n"
+        "def _private():\n    pass\n"
+    )
+    assert _run_checker(tmp_path, src).returncode == 0
+    r = _run_checker(tmp_path, src, "--strict")
+    assert r.returncode == 1
+    assert "def method" in r.stdout
+
+
+def test_repo_tree_is_clean():
+    r = subprocess.run(
+        [sys.executable, f"{REPO}/codestyle/docstring_checker.py",
+         f"{REPO}/fleetx_tpu"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stdout[-1500:]
