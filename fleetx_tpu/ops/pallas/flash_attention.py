@@ -45,8 +45,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention"]
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+import os as _os
+
+# overridable without code changes so block sizes can be swept per TPU
+# generation (bench harness: FLEETX_FLASH_BLOCK_Q=256 python bench.py)
+DEFAULT_BLOCK_Q = int(_os.environ.get("FLEETX_FLASH_BLOCK_Q", 128))
+DEFAULT_BLOCK_K = int(_os.environ.get("FLEETX_FLASH_BLOCK_K", 128))
 NEG_INF = -1e30
 
 # lowbias32 mixing constants (public-domain integer hash); stored as wrapped

@@ -1,0 +1,82 @@
+"""One-shot real-TPU validation + perf sweep, for when a chip is attached.
+
+Runs, in order:
+1. the flash-attention kernel tests on the REAL backend (Mosaic lowering,
+   not the interpreter) — fwd/grad parity incl. the non-causal / kv_lens /
+   dropout paths;
+2. bench.py under a small sweep of batch size x remat x flash block size,
+   printing each JSON line and the best configuration.
+
+    python tools/tpu_preflight.py            # full
+    python tools/tpu_preflight.py --no-sweep # kernel tests only
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SWEEP = [
+    # (batch, recompute, granularity, block_q, block_k)
+    (8, "1", "core_attn", 128, 128),
+    (8, "0", "core_attn", 128, 128),
+    (16, "1", "core_attn", 128, 128),
+    (16, "1", "core_attn", 256, 128),
+    (32, "1", "core_attn", 128, 128),
+    (16, "1", "full_attn", 128, 128),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--no-sweep", action="store_true")
+    ap.add_argument("--steps", default="10")
+    args = ap.parse_args()
+
+    print("== flash kernel tests on the real backend ==", flush=True)
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_flash_attention.py",
+         "-x", "-q", "-p", "no:cacheprovider"],
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "", "FLEETX_LOG_LEVEL": "WARNING"},
+    )
+    if r.returncode != 0:
+        sys.exit("kernel tests FAILED on the real backend; fix before benching")
+
+    if args.no_sweep:
+        return
+    print("== bench sweep ==", flush=True)
+    best = None
+    for batch, rec, gran, bq, bk in SWEEP:
+        env = {
+            **os.environ,
+            "BENCH_BATCH": str(batch), "BENCH_RECOMPUTE": rec,
+            "BENCH_GRANULARITY": gran, "BENCH_STEPS": args.steps,
+            "FLEETX_FLASH_BLOCK_Q": str(bq), "FLEETX_FLASH_BLOCK_K": str(bk),
+        }
+        p = subprocess.run(
+            [sys.executable, "bench.py"], cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=1200,
+        )
+        line = next(
+            (l for l in p.stdout.splitlines() if l.startswith("{")), None
+        )
+        tag = f"b{batch} rec={rec}:{gran} blk={bq}x{bk}"
+        if line is None:
+            print(f"{tag}: FAILED\n{p.stderr[-800:]}")
+            continue
+        rec_json = json.loads(line)
+        print(f"{tag}: {rec_json['value']} tok/s "
+              f"mfu={rec_json['detail']['mfu']}", flush=True)
+        if best is None or rec_json["value"] > best[1]["value"]:
+            best = (tag, rec_json)
+    if best:
+        print("\nBEST:", best[0])
+        print(json.dumps(best[1]))
+
+
+if __name__ == "__main__":
+    main()
