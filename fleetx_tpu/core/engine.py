@@ -186,6 +186,8 @@ class Trainer:
         self._compiled = {}
         self._compiled_raw = {}
         self._restored_step = None
+        self._preempted = False
+        self._prev_sigterm = None
         self.state: Optional[TrainState] = None
         self.start_epoch = 0
         self.consumed_samples = 0
@@ -465,6 +467,15 @@ class Trainer:
         step = int(self.state.step)
         tokens_per_batch = None
         self._profiler_maybe_start(step)
+        self._install_preemption_handler()
+        try:
+            self._fit_epochs(train_data, valid_data, epochs, step,
+                             tokens_per_batch, train_step)
+        finally:
+            self._restore_preemption_handler()
+
+    def _fit_epochs(self, train_data, valid_data, epochs, step,
+                    tokens_per_batch, train_step):
         for epoch in range(self.start_epoch, epochs):
             sampler = getattr(train_data, "batch_sampler", None)
             if sampler is not None and hasattr(sampler, "set_epoch"):
@@ -477,6 +488,14 @@ class Trainer:
             for batch in train_data:
                 if step >= self.max_steps:
                     break
+                if self._preempted:
+                    logger.warning(
+                        "preemption signal received: checkpointing at step %d "
+                        "and exiting fit()", step,
+                    )
+                    self.save(epoch=epoch)
+                    self.wait_for_checkpoints()
+                    return
                 batch = self.module.pretreating_batch(batch)
                 if tokens_per_batch is None:
                     # ips accounting: LM batches carry "tokens", encoder/
@@ -693,6 +712,45 @@ class Trainer:
         self._restored_step = step
         logger.info("restored checkpoint step %d (epoch %d)", step, self.start_epoch)
         return True
+
+    # ------------------------------------------------------------ preemption
+    def _install_preemption_handler(self):
+        """SIGTERM -> finish the in-flight step, checkpoint, exit cleanly.
+
+        TPU-fleet preemptions deliver SIGTERM with a grace window; the
+        reference has no preemption handling (SURVEY §5: recovery is
+        checkpoint-resume only), so a preempted run there loses everything
+        since the last periodic save. Only the main thread may set signal
+        handlers — worker-thread callers just skip this."""
+        import signal
+        import threading
+
+        if threading.current_thread() is not threading.main_thread():
+            return
+
+        def on_sigterm(signum, frame):
+            self._preempted = True  # the fit loop checkpoints + returns
+
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM, on_sigterm)
+        except (ValueError, OSError):  # non-main interpreter contexts
+            self._prev_sigterm = None
+
+    def _restore_preemption_handler(self):
+        """Put back whatever SIGTERM handler fit() displaced."""
+        import signal
+        import threading
+
+        if (
+            self._prev_sigterm is None
+            or threading.current_thread() is not threading.main_thread()
+        ):
+            return
+        try:
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+        except (ValueError, OSError):
+            pass
+        self._prev_sigterm = None
 
     # -------------------------------------------------------------- profiler
     def _profiler_maybe_start(self, step):

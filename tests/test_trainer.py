@@ -241,3 +241,37 @@ def test_profiler_window_and_summary(tmp_path, eight_devices):
     import os
 
     assert os.path.isdir(str(tmp_path / "prof"))
+
+
+def test_preemption_sigterm_checkpoints_and_resumes(tmp_path, eight_devices):
+    """SIGTERM mid-fit checkpoints the current step and exits cleanly; a
+    fresh trainer resumes from it (TPU preemption path; the reference has
+    no preemption handling)."""
+    import os
+    import signal
+
+    cfg = _cfg(tmp_path)
+    cfg.Engine.max_steps = 50
+
+    module = build_module(cfg)
+    trainer = Trainer(cfg, module)
+    data = _batches(cfg, 4)
+
+    class SignalAfter:
+        """Iterable that delivers SIGTERM to this process after 2 batches."""
+
+        def __iter__(self):
+            for i, b in enumerate(data * 20):
+                if i == 2:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                yield b
+
+    trainer.fit(SignalAfter())
+    assert trainer._preempted
+    saved_step = int(trainer.state.step)
+    assert 0 < saved_step < 50  # stopped early, not at max_steps
+
+    module2 = build_module(cfg)
+    trainer2 = Trainer(cfg, module2)
+    trainer2.init_state(data[0])  # resumable dir -> restores in init_state
+    assert int(trainer2.state.step) == saved_step
