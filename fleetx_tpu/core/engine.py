@@ -616,7 +616,9 @@ class Trainer:
             batch = self.module.pretreating_batch(batch)
             feed = {k: batch[k] for k in keys}
             feed = self._shard_batch(feed, for_train=False)
-            outputs.append(np.asarray(jax.device_get(predict_step(self.state, feed))))
+            out = jax.device_get(predict_step(self.state, feed))
+            # multi-output contracts (e.g. ERNIE's (mlm, sop)) stay pytrees
+            outputs.append(jax.tree.map(np.asarray, out))
         return outputs
 
     # ------------------------------------------------------------- checkpoint

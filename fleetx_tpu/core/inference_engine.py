@@ -87,7 +87,8 @@ class InferenceEngine:
         if missing:
             raise ValueError(f"batch missing {missing} (export input_spec)")
         feed = {k: np.asarray(batch[k]) for k in required}
-        return np.asarray(fn(self.params, feed))
+        # multi-output contracts (e.g. ERNIE's (mlm, sop)) stay pytrees
+        return jax.tree.map(np.asarray, fn(self.params, feed))
 
     def generate(self, input_ids: np.ndarray, **overrides):
         """Sampling/greedy decode via the exported Generation config

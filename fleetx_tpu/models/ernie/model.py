@@ -61,6 +61,8 @@ class ErnieConfig:
     type_vocab_size: int = 4
     initializer_range: float = 0.02
     pad_token_id: int = 0
+    # 'gelu_tanh' (reference paddle default) or 'gelu' (erf; HF BERT)
+    hidden_act: str = "gelu_tanh"
     use_recompute: bool = False
     scan_layers: bool = True
     dtype: Dtype = jnp.bfloat16
@@ -131,7 +133,7 @@ class ErnieEncoderLayer(nn.Module):
         )
         x = _layer_norm(cfg, "norm1")(x + y)
         y = _dense(cfg.ffn_size, ("embed", "mlp"), "linear1", dtype=cfg.dtype)(x)
-        y = nn.gelu(y, approximate=True)
+        y = nn.gelu(y, approximate=cfg.hidden_act != "gelu")
         y = _dense(cfg.hidden_size, ("mlp", "embed"), "linear2", dtype=cfg.dtype)(y)
         y = nn.Dropout(cfg.hidden_dropout_prob, name="ffn_dropout")(
             y, deterministic=deterministic
@@ -244,7 +246,7 @@ class ErnieLMHead(nn.Module):
             sequence_output, masked_positions[..., None], axis=1
         )
         h = _dense(cfg.hidden_size, ("embed", None), "transform", dtype=cfg.dtype)(h)
-        h = nn.gelu(h, approximate=True)
+        h = nn.gelu(h, approximate=cfg.hidden_act != "gelu")
         h = _layer_norm(cfg, "transform_norm")(h)
         logits = jnp.einsum(
             "bph,vh->bpv", h.astype(jnp.float32), word_embeddings.astype(jnp.float32)
