@@ -50,6 +50,8 @@ class ViTConfig:
     attn_drop_rate: float = 0.0
     drop_path_rate: float = 0.0
     representation_size: Optional[int] = None
+    # 'gelu_tanh' (reference default) or 'gelu' (erf; HF ViT checkpoints)
+    hidden_act: str = "gelu_tanh"
     use_recompute: bool = False
     dtype: Dtype = jnp.bfloat16
 
@@ -139,7 +141,7 @@ class ViTBlock(nn.Module):
         y = _layer_norm(cfg, "norm2")(x)
         y = _dense(int(cfg.hidden_size * cfg.mlp_ratio), ("embed", "mlp"), "fc1",
                    dtype=cfg.dtype)(y)
-        y = nn.gelu(y, approximate=True)
+        y = nn.gelu(y, approximate=cfg.hidden_act != "gelu")
         y = _dense(cfg.hidden_size, ("mlp", "embed"), "fc2", dtype=cfg.dtype)(y)
         y = nn.Dropout(cfg.drop_rate, name="mlp_drop")(y, deterministic=deterministic)
         x = x + DropPath(self.drop_path, name="drop_path2")(y, deterministic)

@@ -80,6 +80,13 @@ class GeneralClsModule(BasicModule):
     def init_params(self, rng, batch):
         return self.nets.init(rng, jnp.asarray(batch["images"]))
 
+    def serving_forward(self, input_spec):
+        """Serving contract: images -> class logits (export/inference)."""
+        def fwd(p, batch):
+            return self.nets.apply({"params": p}, batch["images"])
+
+        return fwd, ["images"]
+
     def loss_fn(self, params, batch, rng, train: bool):
         images = batch["images"]
         labels = batch["labels"]
