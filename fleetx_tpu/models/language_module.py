@@ -66,6 +66,52 @@ def resolve_compute_dtype(engine_cfg):
             "float32": jnp.float32}[str(name)]
 
 
+def load_pretrained_gpt_backbone(params, artifact_dir, fuse_attn_qkv):
+    """Merge a pretrained GPT backbone from an export artifact into a fresh
+    param tree: weights copied by path under the 'gpt' subtree, fused/split
+    qkv layouts converted to the target config, heads without a pretrained
+    counterpart left at fresh init (reference checkpoint conversion,
+    language_module.py:293-372). Shared by GPTModule (pretrain/eval/
+    generation warm starts, e.g. a converted HF GPT-2) and
+    GPTFinetuneModule."""
+    import numpy as np
+
+    from fleetx_tpu.models.gpt.model import convert_qkv_layout
+    from fleetx_tpu.utils.export import load_exported
+
+    _, src_params, _ = load_exported(artifact_dir)
+    src = src_params.get("gpt", src_params)
+    src = convert_qkv_layout(src, to_fused=fuse_attn_qkv)
+    if "gpt" not in params:
+        raise ValueError("params have no 'gpt' backbone subtree")
+
+    def merge(dst, srcd, path):
+        out = {}
+        for k, v in dst.items():
+            here = f"{path}/{k}"
+            if isinstance(v, dict):
+                out[k] = (
+                    merge(v, srcd[k], here)
+                    if isinstance(srcd.get(k), dict) else v
+                )
+            elif k in srcd:
+                sv = np.asarray(srcd[k])
+                if sv.shape != np.shape(v):
+                    raise ValueError(
+                        f"pretrained shape mismatch at {here}: "
+                        f"{sv.shape} vs {np.shape(v)}"
+                    )
+                out[k] = sv.astype(np.asarray(v).dtype)
+            else:
+                out[k] = v  # no pretrained counterpart: keep fresh init
+        return out
+
+    new = dict(params)
+    new["gpt"] = merge(params["gpt"], src, "gpt")
+    logger.info("loaded pretrained backbone from %s", artifact_dir)
+    return new
+
+
 class GPTModule(LanguageModule):
     """GPT pretraining module: batch = (tokens, position_ids, labels,
     loss_mask)."""
@@ -93,6 +139,17 @@ class GPTModule(LanguageModule):
     def init_params(self, rng, batch):
         tokens = batch["tokens"]
         return self.nets.init(rng, tokens)
+
+    def load_pretrained(self, params):
+        """``Model.pretrained`` (export artifact dir, e.g. from
+        tools/convert_hf_gpt2.py) warm-starts the GPT backbone for
+        pretraining / eval / generation modules."""
+        pre = (self.cfg.Model or {}).get("pretrained")
+        if not pre:
+            return None
+        return load_pretrained_gpt_backbone(
+            params, pre, self.gpt_config.fuse_attn_qkv
+        )
 
     def cp_prepare(self, batch):
         """(tokens, position_ids, labels, loss_mask), zig-zag-permuted along

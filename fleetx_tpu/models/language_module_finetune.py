@@ -68,47 +68,17 @@ class GPTFinetuneModule(LanguageModule):
 
     def load_pretrained(self, params):
         """Map a pretrained GPT backbone (``Model.pretrained`` = export
-        artifact dir) onto the fresh finetune tree: backbone weights copied
-        by path, fused/split qkv layouts converted when the finetune config
-        differs from pretraining, classification head left at fresh init
-        (reference checkpoint conversion, language_module.py:293-372)."""
+        artifact dir) onto the fresh finetune tree with fused/split qkv
+        conversion; the classification head keeps fresh init (reference
+        checkpoint conversion, language_module.py:293-372)."""
         pre = (self.cfg.Model or {}).get("pretrained")
         if not pre:
             return None
-        from fleetx_tpu.models.gpt.model import convert_qkv_layout
-        from fleetx_tpu.utils.export import load_exported
+        from fleetx_tpu.models.language_module import load_pretrained_gpt_backbone
 
-        _, src_params, _ = load_exported(pre)
-        src = src_params.get("gpt", src_params)
-        src = convert_qkv_layout(src, to_fused=self.gpt_config.fuse_attn_qkv)
-        if "gpt" not in params:
-            raise ValueError("finetune params have no 'gpt' backbone subtree")
-
-        def merge(dst, srcd, path):
-            out = {}
-            for k, v in dst.items():
-                here = f"{path}/{k}"
-                if isinstance(v, dict):
-                    out[k] = (
-                        merge(v, srcd[k], here)
-                        if isinstance(srcd.get(k), dict) else v
-                    )
-                elif k in srcd:
-                    sv = np.asarray(srcd[k])
-                    if sv.shape != np.shape(v):
-                        raise ValueError(
-                            f"pretrained shape mismatch at {here}: "
-                            f"{sv.shape} vs {np.shape(v)}"
-                        )
-                    out[k] = sv.astype(np.asarray(v).dtype)
-                else:
-                    out[k] = v  # no pretrained counterpart: keep fresh init
-            return out
-
-        new = dict(params)
-        new["gpt"] = merge(params["gpt"], src, "gpt")
-        logger.info("loaded pretrained backbone from %s", pre)
-        return new
+        return load_pretrained_gpt_backbone(
+            params, pre, self.gpt_config.fuse_attn_qkv
+        )
 
     def loss_fn(self, params, batch, rng, train: bool):
         logits = self.nets.apply(

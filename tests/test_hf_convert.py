@@ -74,3 +74,52 @@ def test_vocab_padding_preserves_real_logits(tmp_path, tiny_hf_ckpt):
     with torch.no_grad():
         theirs = hf_model(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
     np.testing.assert_allclose(ours[..., :97], theirs, rtol=2e-3, atol=2e-3)
+
+
+def test_gpt_module_warm_starts_from_converted_artifact(tmp_path, tiny_hf_ckpt):
+    """Model.pretrained on the pretraining module loads a converted HF
+    backbone (eval/generation warm-start path)."""
+    hf_dir, hf_model = tiny_hf_ckpt
+    out = str(tmp_path / "artifact")
+    r = subprocess.run(
+        [sys.executable, f"{REPO}/tools/convert_hf_gpt2.py",
+         "--hf-dir", hf_dir, "--output", out],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    from fleetx_tpu.core.engine import Trainer, _unbox
+    from fleetx_tpu.models import build_module
+    from fleetx_tpu.utils.config import AttrDict, process_configs
+
+    cfg = AttrDict(
+        Global=AttrDict(seed=0, local_batch_size=2, micro_batch_size=2),
+        Engine=AttrDict(max_steps=1,
+                        save_load=AttrDict(output_dir=str(tmp_path / "o"))),
+        Model=AttrDict(module="GPTModule", pretrained=out,
+                       vocab_size=97, hidden_size=32, num_layers=2,
+                       num_attention_heads=4, ffn_hidden_size=128,
+                       max_position_embeddings=32,
+                       hidden_dropout_prob=0.0,
+                       attention_probs_dropout_prob=0.0,
+                       use_flash_attention=False),
+        Optimizer=AttrDict(name="AdamW", lr=AttrDict(
+            name="CosineAnnealingWithWarmupDecay", decay_steps=10,
+            max_lr=1e-3, min_lr=1e-4)),
+        Distributed=AttrDict(dp_degree=None, mp_degree=1, pp_degree=1),
+    )
+    process_configs(cfg, nranks=1)
+    module = build_module(cfg)
+    trainer = Trainer(cfg, module)
+    batch = {
+        "tokens": np.zeros((2, 16), np.int32),
+        "labels": np.zeros((2, 16), np.int32),
+        "loss_mask": np.ones((2, 16), np.float32),
+    }
+    trainer.init_state(batch)
+    params = jax.tree.map(np.asarray, _unbox(trainer.state.params))
+    wte = hf_model.transformer.wte.weight.detach().numpy()
+    np.testing.assert_allclose(params["gpt"]["word_embeddings"], wte, atol=1e-6)
