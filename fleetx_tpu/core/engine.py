@@ -467,6 +467,7 @@ class Trainer:
         step = int(self.state.step)
         tokens_per_batch = None
         self._profiler_maybe_start(step)
+        self._preempted = False  # a fresh fit() must train, not insta-save
         self._install_preemption_handler()
         try:
             self._fit_epochs(train_data, valid_data, epochs, step,

@@ -63,6 +63,11 @@ class ErnieConfig:
     pad_token_id: int = 0
     # 'gelu_tanh' (reference paddle default) or 'gelu' (erf; HF BERT)
     hidden_act: str = "gelu_tanh"
+    # When True, auto-derived pad masks are expressed as per-example key
+    # lengths so right-padded batches ride the flash kernel. Only enable
+    # when inputs are guaranteed right-padded (the shipped ERNIE datasets
+    # are); the default keeps the exact positional mask semantics.
+    right_padded_inputs: bool = False
     use_recompute: bool = False
     scan_layers: bool = True
     dtype: Dtype = jnp.bfloat16
@@ -163,9 +168,13 @@ class ErnieModel(nn.Module):
         cfg = self.cfg
         if attention_mask is None:
             attention_mask = (input_ids != cfg.pad_token_id).astype(jnp.int32)
-            # shipped datasets right-pad, so the derived mask is a prefix
-            # mask the flash kernel expresses as per-example key lengths
-            masks = (None, jnp.sum(attention_mask, axis=-1).astype(jnp.int32))
+            if cfg.right_padded_inputs:
+                # caller guarantees right padding: the mask is a prefix the
+                # flash kernel expresses as per-example key lengths
+                masks = (None, jnp.sum(attention_mask, axis=-1).astype(jnp.int32))
+            else:
+                # exact positional mask (safe for any padding layout)
+                masks = (attention_mask[:, None, None, :], None)
         else:
             # arbitrary user mask -> broadcastable [b, 1, 1, s] dense form
             masks = (attention_mask[:, None, None, :], None)

@@ -85,6 +85,8 @@ def load_pretrained_gpt_backbone(params, artifact_dir, fuse_attn_qkv):
     if "gpt" not in params:
         raise ValueError("params have no 'gpt' backbone subtree")
 
+    stats = {"matched": 0, "fresh": 0}
+
     def merge(dst, srcd, path):
         out = {}
         for k, v in dst.items():
@@ -102,13 +104,24 @@ def load_pretrained_gpt_backbone(params, artifact_dir, fuse_attn_qkv):
                         f"{sv.shape} vs {np.shape(v)}"
                     )
                 out[k] = sv.astype(np.asarray(v).dtype)
+                stats["matched"] += 1
             else:
                 out[k] = v  # no pretrained counterpart: keep fresh init
+                stats["fresh"] += 1
         return out
 
     new = dict(params)
     new["gpt"] = merge(params["gpt"], src, "gpt")
-    logger.info("loaded pretrained backbone from %s", artifact_dir)
+    if stats["matched"] == 0:
+        raise ValueError(
+            f"no parameter in {artifact_dir} matched the target tree — "
+            "layouts disagree (e.g. scan_layers on one side only); refusing "
+            "to 'warm start' from random init"
+        )
+    logger.info(
+        "loaded pretrained backbone from %s (%d leaves matched, %d fresh)",
+        artifact_dir, stats["matched"], stats["fresh"],
+    )
     return new
 
 

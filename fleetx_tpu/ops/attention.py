@@ -84,12 +84,25 @@ def causal_attention(
     bidirectional encoder with right-padded batches.
     """
     effective_dropout = 0.0 if deterministic else dropout_rate
+
+    def _tileable(s: int) -> bool:
+        # mirror flash_attention's block clamping: env-tuned block sizes
+        # (FLEETX_FLASH_BLOCK_Q/K) must divide the sequence or we fall back
+        # to XLA instead of raising inside the kernel wrapper
+        from fleetx_tpu.ops.pallas.flash_attention import (
+            DEFAULT_BLOCK_K,
+            DEFAULT_BLOCK_Q,
+        )
+
+        bq, bk = min(DEFAULT_BLOCK_Q, s), min(DEFAULT_BLOCK_K, s)
+        return not (s % bq or s % bk or bq % bk)
+
     can_flash = (
         use_flash
         and attn_mask is None
         and (effective_dropout == 0.0 or dropout_rng is not None)
         and q.shape[1] == k.shape[1]  # not incremental decode
-        and q.shape[1] % 128 == 0  # tileable by the kernel block size
+        and _tileable(q.shape[1])
         and jax.default_backend() in ("tpu", "axon")
     )
     if can_flash:

@@ -28,6 +28,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 import numpy as np
 
+from tools.hf_convert_common import linear_t, pack_qkv
+
 from fleetx_tpu.utils.log import logger
 
 
@@ -35,25 +37,18 @@ def convert_state_dict(sd, n_layer: int, n_head: int):
     """HF BertModel state dict (numpy) -> fleetx-tpu ErnieModel param tree."""
     h = sd["embeddings.word_embeddings.weight"].shape[1]
     hd = h // n_head
-
-    def lin_t(name):  # HF Linear [out, in] -> [in, out]
-        return sd[name + ".weight"].T, sd[name + ".bias"]
+    lin_t = lambda name: linear_t(sd, name)  # noqa: E731
 
     layers = []
     for i in range(n_layer):
         pre = f"encoder.layer.{i}."
-        qkv_k, qkv_b = [], []
-        for part in ("query", "key", "value"):
-            w, b = lin_t(pre + f"attention.self.{part}")
-            qkv_k.append(w.reshape(h, n_head, hd))
-            qkv_b.append(b.reshape(n_head, hd))
+        qkv_kernel, qkv_bias = pack_qkv(sd, pre + "attention.self.", n_head, hd)
         ow, ob = lin_t(pre + "attention.output.dense")
         l1w, l1b = lin_t(pre + "intermediate.dense")
         l2w, l2b = lin_t(pre + "output.dense")
         layers.append({
             "attn": {
-                "qkv_proj": {"kernel": np.concatenate(qkv_k, axis=-1),
-                             "bias": np.concatenate(qkv_b, axis=-1)},
+                "qkv_proj": {"kernel": qkv_kernel, "bias": qkv_bias},
                 "out_proj": {"kernel": ow.reshape(n_head, hd, h), "bias": ob},
             },
             "norm1": {"scale": sd[pre + "attention.output.LayerNorm.weight"],

@@ -26,6 +26,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 import numpy as np
 
+from tools.hf_convert_common import linear_t, pack_qkv
+
 from fleetx_tpu.utils.log import logger
 
 
@@ -35,8 +37,7 @@ def convert_state_dict(sd, n_layer: int, n_head: int, num_classes: int):
     h = sd[pk + "embeddings.cls_token"].shape[-1]
     hd = h // n_head
 
-    def lin_t(name):
-        return sd[name + ".weight"].T, sd[name + ".bias"]
+    lin_t = lambda name: linear_t(sd, name)  # noqa: E731
 
     tree = {
         "patch_embed": {
@@ -51,19 +52,16 @@ def convert_state_dict(sd, n_layer: int, n_head: int, num_classes: int):
     }
     for i in range(n_layer):
         pre = pk + f"encoder.layer.{i}."
-        qkv_k, qkv_b = [], []
-        for part in ("query", "key", "value"):
-            w, b = lin_t(pre + f"attention.attention.{part}")
-            qkv_k.append(w.reshape(h, n_head, hd))
-            qkv_b.append(b.reshape(n_head, hd))
+        qkv_kernel, qkv_bias = pack_qkv(
+            sd, pre + "attention.attention.", n_head, hd
+        )
         ow, ob = lin_t(pre + "attention.output.dense")
         f1w, f1b = lin_t(pre + "intermediate.dense")
         f2w, f2b = lin_t(pre + "output.dense")
         tree[f"block_{i}"] = {
             "norm1": {"scale": sd[pre + "layernorm_before.weight"],
                       "bias": sd[pre + "layernorm_before.bias"]},
-            "qkv_proj": {"kernel": np.concatenate(qkv_k, axis=-1),
-                         "bias": np.concatenate(qkv_b, axis=-1)},
+            "qkv_proj": {"kernel": qkv_kernel, "bias": qkv_bias},
             "out_proj": {"kernel": ow.reshape(n_head, hd, h), "bias": ob},
             "norm2": {"scale": sd[pre + "layernorm_after.weight"],
                       "bias": sd[pre + "layernorm_after.bias"]},

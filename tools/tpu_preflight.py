@@ -57,14 +57,18 @@ def main():
             "BENCH_GRANULARITY": gran, "BENCH_STEPS": args.steps,
             "FLEETX_FLASH_BLOCK_Q": str(bq), "FLEETX_FLASH_BLOCK_K": str(bk),
         }
-        p = subprocess.run(
-            [sys.executable, "bench.py"], cwd=REPO, env=env,
-            capture_output=True, text=True, timeout=1200,
-        )
+        tag = f"b{batch} rec={rec}:{gran} blk={bq}x{bk}"
+        try:
+            p = subprocess.run(
+                [sys.executable, "bench.py"], cwd=REPO, env=env,
+                capture_output=True, text=True, timeout=1200,
+            )
+        except subprocess.TimeoutExpired:
+            print(f"{tag}: FAILED (timeout)")  # keep sweeping; partial
+            continue                           # results stay useful
         line = next(
             (l for l in p.stdout.splitlines() if l.startswith("{")), None
         )
-        tag = f"b{batch} rec={rec}:{gran} blk={bq}x{bk}"
         if line is None:
             print(f"{tag}: FAILED\n{p.stderr[-800:]}")
             continue
