@@ -52,7 +52,33 @@ def model_flops_per_token(n_params: int, num_layers: int, seq: int, hidden: int)
     return 6.0 * n_params + num_layers * 6.0 * seq * hidden
 
 
+def _acquire_devices_or_die(timeout_s: int):
+    """jax backend init with a hard watchdog: a wedged TPU tunnel hangs
+    device acquisition forever (deep inside C++, uninterruptible), which
+    would block the whole benchmark harness. Better a loud nonzero exit."""
+    import threading
+
+    acquired = threading.Event()
+
+    def watchdog():
+        if not acquired.wait(timeout_s):
+            sys.stderr.write(
+                f"bench: jax device acquisition exceeded {timeout_s}s "
+                "(TPU tunnel wedged?); aborting\n"
+            )
+            sys.stderr.flush()
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    import jax
+
+    devices = jax.devices()
+    acquired.set()
+    return devices
+
+
 def main():
+    _acquire_devices_or_die(int(os.environ.get("BENCH_INIT_TIMEOUT", 300)))
     import jax
 
     from fleetx_tpu.core.engine import Trainer
