@@ -29,6 +29,14 @@ Layout: q, k, v are [batch, seq, heads, head_dim] (model layout); kernels run
 per (batch*head) over q-row blocks, scanning k-column blocks up to the causal
 diagonal (or the full row when non-causal). fp32 accumulation, inputs any
 float dtype.
+
+Regime note: each program holds one full K/V row in VMEM (2 * seq *
+head_dim * 4B), which caps per-device sequence around ~8-16k at head_dim
+64-128 on 16 MiB-VMEM parts. Long-context training shards sequence over
+the cp axis first (parallel/context_parallel.py ring attention), so the
+per-device slice stays inside this envelope; lifting the cap entirely
+(grid-streamed K blocks with Pallas-pipelined HBM loads) is the next
+kernel iteration.
 """
 
 from __future__ import annotations

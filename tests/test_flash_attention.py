@@ -230,3 +230,11 @@ def test_fully_masked_rows_emit_zeros_not_nan():
     out = flash_attention(q, k, v, causal=False, kv_lens=kv_lens)
     assert np.isfinite(np.asarray(out)).all()
     np.testing.assert_array_equal(np.asarray(out[0]), 0.0)
+
+
+def test_long_sequence_2048():
+    """Longer-seq smoke at 2048 (the in-VMEM K/V regime still holds)."""
+    q, k, v = _qkv(b=1, s=2048, h=1, d=32)
+    out = flash_attention(q, k, v)
+    ref = _ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
