@@ -214,3 +214,29 @@ def test_ernie_module_end_to_end(tmp_path, ernie_data, eight_devices):
     loader = build_dataloader(cfg, "Train")
     trainer.fit(loader)
     assert int(trainer.state.step) == 4
+
+
+def test_right_padded_inputs_flag_matches_exact_mask():
+    """right_padded_inputs=True (kv_lens fast path) must equal the exact
+    positional-mask default for genuinely right-padded batches."""
+    import dataclasses
+
+    rng = np.random.RandomState(3)
+    ids = rng.randint(4, 128, (2, 16)).astype(np.int32)
+    ids[0, -5:] = 0  # right padding (pad_token_id = 0)
+    ids[1, -2:] = 0
+
+    exact = ErnieModel(CFG)
+    fast = ErnieModel(dataclasses.replace(CFG, right_padded_inputs=True))
+    vars_ = exact.init(jax.random.PRNGKey(0), jnp.asarray(ids))
+    seq_a, pool_a = exact.apply(vars_, jnp.asarray(ids))
+    seq_b, pool_b = fast.apply(vars_, jnp.asarray(ids))
+    # compare non-pad positions: padded query rows differ by design (the
+    # kv_lens path zeroes fully-masked rows; both are downstream-masked)
+    valid = ids != 0
+    np.testing.assert_allclose(
+        np.asarray(seq_a)[valid], np.asarray(seq_b)[valid], rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(pool_a), np.asarray(pool_b), rtol=1e-5, atol=1e-5
+    )
