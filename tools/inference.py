@@ -27,6 +27,12 @@ def main():
                     "comma-separated token ids")
     ap.add_argument("--vocab-dir", default=None)
     ap.add_argument("--max-length", type=int, default=None)
+    ap.add_argument("--decode-strategy", default=None,
+                    help="greedy | sampling | beam_search (overrides export)")
+    ap.add_argument("--num-beams", type=int, default=None)
+    ap.add_argument("--top-k", type=int, default=None)
+    ap.add_argument("--top-p", type=float, default=None)
+    ap.add_argument("--temperature", type=float, default=None)
     args = ap.parse_args()
 
     export_dir = args.export_dir
@@ -62,6 +68,10 @@ def main():
     kw = {}
     if args.max_length:
         kw["max_length"] = args.max_length
+    for name in ("decode_strategy", "num_beams", "top_k", "top_p", "temperature"):
+        val = getattr(args, name)
+        if val is not None:
+            kw[name] = val
     out = np.asarray(engine.generate(ids, **kw))
     gen = out[0][ids.shape[1]:]
     eos = np.nonzero(gen == engine.eos_token_id)[0]
