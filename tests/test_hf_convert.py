@@ -123,3 +123,27 @@ def test_gpt_module_warm_starts_from_converted_artifact(tmp_path, tiny_hf_ckpt):
     params = jax.tree.map(np.asarray, _unbox(trainer.state.params))
     wte = hf_model.transformer.wte.weight.detach().numpy()
     np.testing.assert_allclose(params["gpt"]["word_embeddings"], wte, atol=1e-6)
+
+
+def test_int8_quantized_artifact_close_to_fp32(tmp_path, tiny_hf_ckpt):
+    """--quantize int8 stores int8 weights; served logits stay close to the
+    fp32 artifact (weight-only per-channel quantization)."""
+    hf_dir, hf_model = tiny_hf_ckpt
+    out = str(tmp_path / "artifact_int8")
+    r = subprocess.run(
+        [sys.executable, f"{REPO}/tools/convert_hf_gpt2.py",
+         "--hf-dir", hf_dir, "--output", out, "--quantize", "int8"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    sys.path.insert(0, REPO)
+    from fleetx_tpu.core.inference_engine import InferenceEngine
+
+    engine = InferenceEngine(out)
+    tokens = np.arange(32, dtype=np.int32).reshape(2, 16)
+    ours = engine.predict({"tokens": tokens})
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+    # int8 drift tolerance is looser than the fp32 parity tests
+    np.testing.assert_allclose(ours, theirs, rtol=0.2, atol=0.5)

@@ -87,6 +87,8 @@ def main():
     ap.add_argument("--output", required=True, help="export artifact dir")
     ap.add_argument("--pad-vocab-multiple", type=int, default=0,
                     help="pad vocab to a multiple (e.g. 128) for TPU tiling")
+    ap.add_argument("--quantize", choices=["int8"], default=None,
+                    help="store weight-only int8 params in the artifact")
     args = ap.parse_args()
 
     from transformers import GPT2Config, GPT2LMHeadModel
@@ -129,7 +131,8 @@ def main():
     )
     process_configs(cfg, nranks=1)
     module = build_module(cfg)
-    export_inference_model(module, {"gpt": gpt_tree}, args.output)
+    export_inference_model(module, {"gpt": gpt_tree}, args.output,
+                           quantize=args.quantize)
     logger.info(
         "converted %s (%d layers, %d heads, vocab %d) -> %s",
         args.hf_dir, hf_cfg.n_layer, hf_cfg.n_head, vocab, args.output,
