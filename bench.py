@@ -72,8 +72,17 @@ def _acquire_devices_or_die(timeout_s: int):
     threading.Thread(target=watchdog, daemon=True).start()
     import jax
 
-    devices = jax.devices()
-    acquired.set()
+    if os.environ.get("BENCH_PLATFORM"):
+        # explicit platform override (e.g. BENCH_PLATFORM=cpu for smoke
+        # runs): the sandbox sitecustomize re-pins JAX_PLATFORMS after env
+        # vars are read, so the config update is the only reliable knob
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    try:
+        devices = jax.devices()
+    finally:
+        # set even on a fast raise, so the watchdog only fires on a genuine
+        # hang and a caller that catches the exception can recover
+        acquired.set()
     return devices
 
 
