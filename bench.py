@@ -53,37 +53,15 @@ def model_flops_per_token(n_params: int, num_layers: int, seq: int, hidden: int)
 
 
 def _acquire_devices_or_die(timeout_s: int):
-    """jax backend init with a hard watchdog: a wedged TPU tunnel hangs
-    device acquisition forever (deep inside C++, uninterruptible), which
-    would block the whole benchmark harness. Better a loud nonzero exit."""
-    import threading
+    from fleetx_tpu.utils.device_guard import acquire_devices_or_die
 
-    acquired = threading.Event()
-
-    def watchdog():
-        if not acquired.wait(timeout_s):
-            sys.stderr.write(
-                f"bench: jax device acquisition exceeded {timeout_s}s "
-                "(TPU tunnel wedged?); aborting\n"
-            )
-            sys.stderr.flush()
-            os._exit(3)
-
-    threading.Thread(target=watchdog, daemon=True).start()
-    import jax
-
-    if os.environ.get("BENCH_PLATFORM"):
-        # explicit platform override (e.g. BENCH_PLATFORM=cpu for smoke
-        # runs): the sandbox sitecustomize re-pins JAX_PLATFORMS after env
-        # vars are read, so the config update is the only reliable knob
-        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
-    try:
-        devices = jax.devices()
-    finally:
-        # set even on a fast raise, so the watchdog only fires on a genuine
-        # hang and a caller that catches the exception can recover
-        acquired.set()
-    return devices
+    # BENCH_PLATFORM=cpu enables smoke runs: the sandbox sitecustomize
+    # re-pins JAX_PLATFORMS after env vars are read, so only the config
+    # update (inside the guard) works
+    return acquire_devices_or_die(
+        timeout_s, label="bench",
+        platform_override=os.environ.get("BENCH_PLATFORM") or None,
+    )
 
 
 def main():

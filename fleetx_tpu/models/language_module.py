@@ -125,6 +125,59 @@ def load_pretrained_gpt_backbone(params, artifact_dir, fuse_attn_qkv):
     return new
 
 
+def init_pipeline_params_via_sequential(nets, rng, tokens):
+    """Initialize a pp>1 GPT through its SEQUENTIAL twin, then remap.
+
+    The pipeline scopes (nn.scan over ticks -> nn.vmap over stages -> nn.scan
+    over layers) fold the init RNG differently than the plain layer scan, so
+    initializing the pp model directly gives different weights than the
+    single-device model for the same seed. Parallelism must stay a layout
+    choice (sharded 1-step loss == single-device loss): init the pp=1 twin,
+    reshape [L, ...] -> [pp, L/pp, ...] with the checkpoint converter, and
+    graft the values into the pp model's own axis-metadata boxes so sharding
+    derivation still sees the pipeline's logical axes ('stage', 'layers')."""
+    import dataclasses
+
+    import flax
+    import flax.linen as nn
+
+    from fleetx_tpu.parallel.pipeline import sequential_params_to_pipeline
+
+    gcfg = nets.cfg
+    seq_cfg = dataclasses.replace(
+        gcfg, pp_degree=1, num_microbatches=1, virtual_pp_degree=1,
+        scan_layers=True, no_recompute_layers=None,
+    )
+    seq_vars = type(nets)(seq_cfg).init(rng, tokens)
+    is_box = lambda x: isinstance(x, nn.meta.AxisMetadata)
+    unboxed = jax.tree.map(
+        lambda x: x.unbox() if is_box(x) else x,
+        flax.core.unfreeze(seq_vars),
+        is_leaf=is_box,
+    )
+    remapped = sequential_params_to_pipeline(
+        unboxed, gcfg.pp_degree, max(gcfg.virtual_pp_degree, 1)
+    )
+    abstract = jax.eval_shape(lambda r: nets.init(r, tokens), rng)
+    flat_abs = flax.traverse_util.flatten_dict(
+        flax.core.unfreeze(abstract), sep="/"
+    )
+    flat_val = flax.traverse_util.flatten_dict(
+        flax.core.unfreeze(remapped), sep="/"
+    )
+    if set(flat_abs) != set(flat_val):
+        missing = set(flat_abs) ^ set(flat_val)
+        raise ValueError(
+            f"sequential->pipeline param remap mismatch at: {sorted(missing)[:5]}"
+        )
+    out = {
+        k: box.replace_boxed(flat_val[k].astype(box.unbox().dtype))
+        if is_box(box) else flat_val[k]
+        for k, box in flat_abs.items()
+    }
+    return flax.traverse_util.unflatten_dict(out, sep="/")
+
+
 class GPTModule(LanguageModule):
     """GPT pretraining module: batch = (tokens, position_ids, labels,
     loss_mask)."""
@@ -151,7 +204,9 @@ class GPTModule(LanguageModule):
 
     def init_params(self, rng, batch):
         tokens = batch["tokens"]
-        return self.nets.init(rng, tokens)
+        if (getattr(self.gpt_config, "pp_degree", 1) or 1) <= 1:
+            return self.nets.init(rng, tokens)
+        return init_pipeline_params_via_sequential(self.nets, rng, tokens)
 
     def load_pretrained(self, params):
         """``Model.pretrained`` (export artifact dir, e.g. from

@@ -1,0 +1,51 @@
+"""Watchdog around first jax backend init.
+
+A wedged TPU tunnel hangs device acquisition forever inside C++
+(uninterruptible by signals the Python layer can catch), which would block
+any harness driving this repo. Better a loud nonzero exit than a silent
+hang: a daemon thread os._exit(3)s the process if acquisition exceeds the
+timeout. ``acquired`` is set in a finally so a *fast raise* (e.g. unknown
+backend) never triggers the delayed exit — the watchdog fires only on a
+genuine hang.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ["acquire_devices_or_die"]
+
+
+def acquire_devices_or_die(timeout_s: int = 300, label: str = "fleetx",
+                           platform_override: str | None = None):
+    """Return ``jax.devices()``, aborting the process (exit 3) on a hang.
+
+    ``platform_override`` pins ``jax_platforms`` via jax.config before the
+    first device query — the sandbox sitecustomize re-pins JAX_PLATFORMS
+    after env vars are read, so the config update is the only reliable knob
+    (same trick as tests/conftest.py).
+    """
+    import threading
+
+    acquired = threading.Event()
+
+    def watchdog():
+        if not acquired.wait(timeout_s):
+            sys.stderr.write(
+                f"{label}: jax device acquisition exceeded {timeout_s}s "
+                "(TPU tunnel wedged?); aborting\n"
+            )
+            sys.stderr.flush()
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    import jax
+
+    if platform_override:
+        jax.config.update("jax_platforms", platform_override)
+    try:
+        devices = jax.devices()
+    finally:
+        acquired.set()
+    return devices
