@@ -8,8 +8,8 @@ the [s, s] score matrix out of HBM entirely, so long sequences don't need the
 reference's ``recompute_granularity=core_attn`` memory workaround.
 
 Two masking modes, both resolved inside the kernels:
-- ``causal=True``: lower-triangular (GPT decoders); k-block scan stops at
-  the diagonal.
+- ``causal=True``: lower-triangular (GPT decoders); the k-block axis of the
+  grid stops contributing at the diagonal.
 - ``kv_lens`` (optional, [batch] int32): right-padding key mask — position
   k attends only if ``k < kv_lens[b]``. This is the contiguous-padding
   form of the reference encoder's ``attention_mask`` (ernie single_model
@@ -25,18 +25,22 @@ determinism via its CUDA RNG tracker ``local_seed``
 int32 arithmetic, so the kernel behaves identically under the Pallas
 interpreter on CPU (where pltpu.prng_* has no lowering) and on real TPUs.
 
-Layout: q, k, v are [batch, seq, heads, head_dim] (model layout); kernels run
-per (batch*head) over q-row blocks, scanning k-column blocks up to the causal
-diagonal (or the full row when non-causal). fp32 accumulation, inputs any
-float dtype.
+Layout: q, k, v are [batch, seq, heads, head_dim] (model layout).
 
-Regime note: each program holds one full K/V row in VMEM (2 * seq *
-head_dim * 4B), which caps per-device sequence around ~8-16k at head_dim
-64-128 on 16 MiB-VMEM parts. Long-context training shards sequence over
-the cp axis first (parallel/context_parallel.py ring attention), so the
-per-device slice stays inside this envelope; lifting the cap entirely
-(grid-streamed K blocks with Pallas-pipelined HBM loads) is the next
-kernel iteration.
+Grid-streamed K/V (this file's round-4 iteration; previously each program
+held the full K/V row in VMEM, capping per-device sequence at ~8-16k):
+every kernel runs a 3D grid whose innermost axis walks K (or Q) blocks, so
+VMEM holds only one resident block per operand plus the online-softmax
+carry in scratch — VMEM use is independent of sequence length, and Mosaic
+double-buffers the streamed blocks (DMA of block j+1 overlaps compute of
+block j). Causal skipping exploits two Pallas grid facts:
+- an input whose index_map returns the same block index on consecutive
+  steps is NOT re-fetched, so clamping the k-block index at the causal
+  diagonal makes the skipped upper-triangle steps free of HBM traffic;
+- ``pl.when`` guards the compute, so skipped steps retire immediately.
+The grid's innermost axis is sequential on TPU ("arbitrary" dimension
+semantics), which is what makes the scratch carry across k steps valid;
+(batch*head, q-block) are marked parallel for megacore partitioning.
 """
 
 from __future__ import annotations
@@ -55,10 +59,31 @@ __all__ = ["flash_attention"]
 
 import os as _os
 
+
+def _env_block(name: str, default: int) -> int:
+    """Env-tunable block size; validated once at import (ADVICE r3 #4:
+    a 0/negative override used to surface as ZeroDivisionError at dispatch)."""
+    raw = _os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        val = int(raw)
+    except ValueError as e:
+        raise ValueError(f"{name}={raw!r} is not an integer") from e
+    if val <= 0 or val % 8:
+        # blocks tile the (second-to-last) sequence dim, so only sublane (8)
+        # alignment is required — head_dim carries the 128-lane constraint
+        raise ValueError(
+            f"{name}={val} invalid: block sizes must be positive multiples "
+            "of the 8-row TPU sublane tile"
+        )
+    return val
+
+
 # overridable without code changes so block sizes can be swept per TPU
 # generation (bench harness: FLEETX_FLASH_BLOCK_Q=256 python bench.py)
-DEFAULT_BLOCK_Q = int(_os.environ.get("FLEETX_FLASH_BLOCK_Q", 128))
-DEFAULT_BLOCK_K = int(_os.environ.get("FLEETX_FLASH_BLOCK_K", 128))
+DEFAULT_BLOCK_Q = _env_block("FLEETX_FLASH_BLOCK_Q", 128)
+DEFAULT_BLOCK_K = _env_block("FLEETX_FLASH_BLOCK_K", 128)
 NEG_INF = -1e30
 
 # lowbias32 mixing constants (public-domain integer hash); stored as wrapped
@@ -73,6 +98,14 @@ _C3 = np.int32(np.uint32(0xC2B2AE3D))
 def _interpret() -> bool:
     """Pallas interpreter mode off-TPU (CPU tests of kernel math)."""
     return jax.default_backend() not in ("tpu", "axon")
+
+
+def _compiler_params():
+    # innermost grid axis carries the online-softmax scratch state, so it
+    # must stay sequential; the outer two can partition over megacores
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary")
+    )
 
 
 def _shr(x, n):
@@ -107,32 +140,53 @@ def _score_mask(q_pos, k_pos, kvlen, causal: bool):
     return mask
 
 
+def _last_k_block(i, block_q: int, block_k: int, causal: bool, n_k: int):
+    """Index of the last k block the i-th q block attends to."""
+    if not causal:
+        return n_k - 1
+    return ((i + 1) * block_q) // block_k - 1
+
+
+def _kv_index_map(block_q: int, block_k: int, causal: bool, n_k: int):
+    """K/V block index for grid step (bh, i, j): clamped at the causal
+    diagonal so steps past it repeat the previous index (no DMA)."""
+
+    def index_map(b, i, j):
+        return b, jnp.minimum(j, _last_k_block(i, block_q, block_k, causal, n_k)), 0
+
+    return index_map
+
+
 def _fwd_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                *, block_k: int, scale: float, dropout_rate: float,
-                causal: bool, seq_len: int):
-    """One (batch*head, q-block) program: online softmax over k blocks."""
+                m_scr, l_scr, acc_scr, *, block_k: int, scale: float,
+                dropout_rate: float, causal: bool, n_k: int):
+    """Grid step (bh, q-block i, k-block j): one online-softmax update."""
     bq, d = q_ref.shape
     bh = pl.program_id(0)
     i = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * scale
-    kvlen = kvlens_ref[bh]
+    j = pl.program_id(2)
+    last_j = _last_k_block(i, bq, block_k, causal, n_k)
 
-    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-
-    def body(j, carry):
-        m, l, acc = carry
-        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(j <= last_j)
+    def _step():
+        q = q_ref[:].astype(jnp.float32) * scale
+        kvlen = kvlens_ref[bh]
+        k_blk = k_ref[:].astype(jnp.float32)
+        v_blk = v_ref[:].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, block_k]
+        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
         s = jnp.where(_score_mask(q_pos, k_pos, kvlen, causal), s, NEG_INF)
 
+        m = m_scr[:]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         # fully-masked rows: keep p exactly 0 (avoids exp(NEG-NEG)=1 garbage
@@ -141,43 +195,48 @@ def _fwd_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         alpha = jnp.exp(m - m_new)
         # The softmax normalizer sums the *undropped* probabilities; dropout
         # scales only the value-weighted path (out = dropout(softmax(s)) @ v).
-        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
         if dropout_rate > 0.0:
             p = p * dropout_keep_scale(seed_ref[0], bh, q_pos, k_pos, dropout_rate)
-        acc_new = alpha * acc + jax.lax.dot_general(
+        acc_scr[:] = alpha * acc_scr[:] + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
+        m_scr[:] = m_new
 
-    # causal: only k blocks at or before this q block contribute
-    # (block_q % block_k == 0 enforced at dispatch)
-    num_k_blocks = (i + 1) * bq // block_k if causal else seq_len // block_k
-    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
-
-    l_safe = jnp.where(l > 0.0, l, 1.0)  # fully-masked rows emit zeros
-    o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[:] = m + jnp.log(l_safe)  # [bq, 1] tile of the (bh, s, 1) array
+    @pl.when(j == last_j)
+    def _finalize():
+        l = l_scr[:]
+        l_safe = jnp.where(l > 0.0, l, 1.0)  # fully-masked rows emit zeros
+        o_ref[:] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[:] = m_scr[:] + jnp.log(l_safe)  # [bq, 1] tile of (bh, s, 1)
 
 
 def _bwd_dq_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                   delta_ref, dq_ref, *, block_k: int, scale: float,
-                   dropout_rate: float, causal: bool, seq_len: int):
+                   delta_ref, dq_ref, dq_scr, *, block_k: int, scale: float,
+                   dropout_rate: float, causal: bool, n_k: int):
     bq, d = q_ref.shape
     bh = pl.program_id(0)
     i = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * scale
-    do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:]      # [bq, 1]
-    delta = delta_ref[:]  # [bq, 1]
-    kvlen = kvlens_ref[bh]
-    q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    j = pl.program_id(2)
+    last_j = _last_k_block(i, bq, block_k, causal, n_k)
 
-    def body(j, dq):
-        k_blk = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    @pl.when(j <= last_j)
+    def _step():
+        q = q_ref[:].astype(jnp.float32) * scale
+        do = do_ref[:].astype(jnp.float32)
+        lse = lse_ref[:]      # [bq, 1]
+        delta = delta_ref[:]  # [bq, 1]
+        kvlen = kvlens_ref[bh]
+        k_blk = k_ref[:].astype(jnp.float32)
+        v_blk = v_ref[:].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
+        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
         mask = _score_mask(q_pos, k_pos, kvlen, causal)
         s = jnp.where(mask, s, NEG_INF)
@@ -190,39 +249,62 @@ def _bwd_dq_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             # because delta = rowsum(dO ∘ O) and O = (P ∘ mask) @ V.
             dp = dp * dropout_keep_scale(seed_ref[0], bh, q_pos, k_pos, dropout_rate)
         ds = p * (dp - delta)
-        return dq + jax.lax.dot_general(
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    num_k_blocks = (i + 1) * bq // block_k if causal else seq_len // block_k
-    dq = jax.lax.fori_loop(0, num_k_blocks, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(j == last_j)
+    def _finalize():
+        dq_ref[:] = (dq_scr[:] * scale).astype(dq_ref.dtype)
+
+
+def _first_q_block(j, block_q: int, block_k: int, causal: bool):
+    """Index of the first q block that sees the j-th k block."""
+    if not causal:
+        return 0
+    return (j * block_k) // block_q
+
+
+def _q_stream_index_map(block_q: int, block_k: int, causal: bool):
+    """Q-side block index for dkv grid step (bh, j, ii): clamped below at
+    the causal diagonal so pre-diagonal steps repeat one index (no DMA)."""
+
+    def index_map(b, j, ii):
+        return b, jnp.maximum(ii, _first_q_block(j, block_q, block_k, causal)), 0
+
+    return index_map
 
 
 def _bwd_dkv_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                    delta_ref, dk_ref, dv_ref, *, block_q: int, scale: float,
-                    seq_len: int, dropout_rate: float, causal: bool):
+                    delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    block_q: int, scale: float, dropout_rate: float,
+                    causal: bool, n_q: int):
     bk, d = k_ref.shape
     bh = pl.program_id(0)
     j = pl.program_id(1)
-    k = k_ref[:].astype(jnp.float32)
-    v = v_ref[:].astype(jnp.float32)
-    kvlen = kvlens_ref[bh]
-    k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-    # causal: only q blocks at/after this k block see it; non-causal: all
-    first_q_block = j * bk // block_q if causal else 0
+    ii = pl.program_id(2)
+    first_i = _first_q_block(j, block_q, bk, causal)
 
-    def body(ii, carry):
-        dk, dv = carry
-        i = first_q_block + ii
-        q_blk = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
-        do_blk = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(i * block_q, block_q), :]      # [block_q, 1]
-        delta = delta_ref[pl.ds(i * block_q, block_q), :]  # [block_q, 1]
+    @pl.when(ii == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    @pl.when(ii >= first_i)
+    def _step():
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        kvlen = kvlens_ref[bh]
+        q_blk = q_ref[:].astype(jnp.float32) * scale
+        do_blk = do_ref[:].astype(jnp.float32)
+        lse = lse_ref[:]      # [block_q, 1]
+        delta = delta_ref[:]  # [block_q, 1]
         s = jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+        q_pos = ii * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 0)
+        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
         mask = _score_mask(q_pos, k_pos, kvlen, causal)
         s = jnp.where(mask, s, NEG_INF)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
@@ -235,23 +317,20 @@ def _bwd_dkv_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             dp = dp * drop
         else:
             p_v = p
-        dv = dv + jax.lax.dot_general(
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p_v, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta)
-        dk = dk + jax.lax.dot_general(
+        # q blocks were loaded pre-scaled, so the chain rule's `scale`
+        # factor is already inside `ds @ q_scaled`
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return dk, dv
 
-    n_iter = seq_len // block_q - first_q_block
-    dk, dv = jax.lax.fori_loop(
-        0, n_iter, body, (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32))
-    )
-    # q blocks were loaded pre-scaled, so the chain rule's `scale` factor is
-    # already inside `ds @ q_scaled`
-    dk_ref[:] = dk.astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+    @pl.when(ii == n_q - 1)
+    def _finalize():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _to_bh(x):
@@ -272,31 +351,39 @@ def _seed_spec():
 def _fwd_call(seed, kvlens, q3, k3, v3, block_q, block_k, scale, dropout_rate,
               causal):
     bh, s, d = q3.shape
-    grid = (bh, s // block_q)
+    n_k = s // block_k
+    grid = (bh, s // block_q, n_k)
     kernel = functools.partial(
         _fwd_kernel, block_k=block_k, scale=scale, dropout_rate=dropout_rate,
-        causal=causal, seq_len=s,
+        causal=causal, n_k=n_k,
     )
+    kv_map = _kv_index_map(block_q, block_k, causal, n_k)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             _seed_spec(),
             _seed_spec(),
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), kv_map),
+            pl.BlockSpec((None, block_k, d), kv_map),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
             # trailing singleton dim: Mosaic requires the last block dim to
             # divide 128 or equal the array dim — (block_q, 1) satisfies it
-            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
             jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max m
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running normalizer l
+            pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
+        ],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(seed, kvlens, q3, k3, v3)
 
@@ -322,55 +409,66 @@ def _flash_bwd(block_q, block_k, dropout_rate, causal, res, g):
     q3, k3, v3, o3, lse, seed, kvlens, b, h = res
     bh, s, d = q3.shape
     scale = 1.0 / (d**0.5)
+    n_k = s // block_k
+    n_q = s // block_q
     do3 = _to_bh(g)
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1,
                     keepdims=True)  # [bh, s, 1]
 
+    kv_map = _kv_index_map(block_q, block_k, causal, n_k)
     dq3 = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, block_k=block_k, scale=scale,
-            dropout_rate=dropout_rate, causal=causal, seq_len=s,
+            dropout_rate=dropout_rate, causal=causal, n_k=n_k,
         ),
-        grid=(bh, s // block_q),
+        grid=(bh, n_q, n_k),
         in_specs=[
             _seed_spec(),
             _seed_spec(),
-            pl.BlockSpec((None, block_q, d), lambda b_, i: (b_, i, 0)),
-            pl.BlockSpec((None, s, d), lambda b_, i: (b_, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda b_, i: (b_, 0, 0)),
-            pl.BlockSpec((None, block_q, d), lambda b_, i: (b_, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b_, i: (b_, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b_, i: (b_, i, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((None, block_k, d), kv_map),
+            pl.BlockSpec((None, block_k, d), kv_map),
+            pl.BlockSpec((None, block_q, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b_, i, j: (b_, i, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda b_, i: (b_, i, 0)),
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b_, i, j: (b_, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(seed, kvlens, q3, k3, v3, do3, lse, delta)
 
+    q_map = _q_stream_index_map(block_q, block_k, causal)
     dk3, dv3 = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, block_q=block_q, scale=scale, seq_len=s,
-            dropout_rate=dropout_rate, causal=causal,
+            _bwd_dkv_kernel, block_q=block_q, scale=scale,
+            dropout_rate=dropout_rate, causal=causal, n_q=n_q,
         ),
-        grid=(bh, s // block_k),
+        grid=(bh, n_k, n_q),
         in_specs=[
             _seed_spec(),
             _seed_spec(),
-            pl.BlockSpec((None, s, d), lambda b_, j: (b_, 0, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b_, j: (b_, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b_, j: (b_, j, 0)),
-            pl.BlockSpec((None, s, d), lambda b_, j: (b_, 0, 0)),
-            pl.BlockSpec((None, s, 1), lambda b_, j: (b_, 0, 0)),
-            pl.BlockSpec((None, s, 1), lambda b_, j: (b_, 0, 0)),
+            pl.BlockSpec((None, block_q, d), q_map),
+            pl.BlockSpec((None, block_k, d), lambda b_, j, ii: (b_, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b_, j, ii: (b_, j, 0)),
+            pl.BlockSpec((None, block_q, d), q_map),
+            pl.BlockSpec((None, block_q, 1), q_map),
+            pl.BlockSpec((None, block_q, 1), q_map),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_k, d), lambda b_, j: (b_, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b_, j: (b_, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b_, j, ii: (b_, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b_, j, ii: (b_, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), k3.dtype),
             jax.ShapeDtypeStruct((bh, s, d), v3.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(seed, kvlens, q3, k3, v3, do3, lse, delta)
 
