@@ -84,6 +84,14 @@ def _env_block(name: str, default: int) -> int:
 # generation (bench harness: FLEETX_FLASH_BLOCK_Q=256 python bench.py)
 DEFAULT_BLOCK_Q = _env_block("FLEETX_FLASH_BLOCK_Q", 128)
 DEFAULT_BLOCK_K = _env_block("FLEETX_FLASH_BLOCK_K", 128)
+if DEFAULT_BLOCK_Q % DEFAULT_BLOCK_K:
+    # the dispatch-time tileability check requires block_k | block_q; catch
+    # a bad override pair at import instead of silently routing every call
+    # to the XLA fallback
+    raise ValueError(
+        f"FLEETX_FLASH_BLOCK_Q={DEFAULT_BLOCK_Q} must be a multiple of "
+        f"FLEETX_FLASH_BLOCK_K={DEFAULT_BLOCK_K}"
+    )
 NEG_INF = -1e30
 
 # lowbias32 mixing constants (public-domain integer hash); stored as wrapped

@@ -14,6 +14,12 @@ folding trunk, never both in one step. ``row_to_col``/``col_to_row``
 become a change of sharding constraint; GSPMD inserts exactly the
 all_to_all the reference wrote by hand (dap.py:244-343), and overlaps it
 with compute.
+
+Branch parallelism (the reference's bp_degree=2 track split) is NOT layered
+on top: DAP already distributes both evoformer tracks over the same
+devices, so BP would only move FLOPs around while adding joins — see
+fleetx_tpu/parallel/bp.py for the recorded decision and the shard_map
+formulation provided for the cases that still want it.
 """
 
 from __future__ import annotations

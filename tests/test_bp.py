@@ -1,0 +1,118 @@
+"""Branch parallelism (parallel/bp.py): the reference's bp_degree=2 split
+(reference bp.py:52, evoformer.py:277-341) expressed as shard_map + cond +
+psum. Forward must equal running both branches directly; gradients must
+match (the psum transpose is the reference's hand-written all-reduce)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from fleetx_tpu.parallel.bp import branch_parallel2
+
+
+def _mesh(cp):
+    devs = np.asarray(jax.devices()[:cp]).reshape(cp)
+    return Mesh(devs, ("cp",))
+
+
+def test_forward_matches_direct(eight_devices):
+    mesh = _mesh(2)
+    x = jnp.arange(24.0, dtype=jnp.float32).reshape(4, 6)
+    w0 = jnp.full((6, 3), 0.5, jnp.float32)
+    w1 = jnp.full((6, 2), -1.5, jnp.float32)
+
+    fn0 = lambda x, w: jnp.tanh(x @ w)
+    fn1 = lambda x, w: (x @ w) ** 2
+
+    y0, y1 = branch_parallel2(fn0, fn1, (x, w0), (x, w1), mesh)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(fn0(x, w0)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(fn1(x, w1)), rtol=1e-6)
+
+
+@pytest.mark.parametrize("cp", [2, 4])
+def test_grads_match_direct(eight_devices, cp):
+    """Gradients through both branches — including a SHARED input feeding
+    both (the pair_act case whose grad the reference all-reduces)."""
+    mesh = _mesh(cp)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 6))
+    w0 = jax.random.normal(jax.random.PRNGKey(1), (6, 3))
+    w1 = jax.random.normal(jax.random.PRNGKey(2), (6, 2))
+
+    fn0 = lambda x, w: jnp.tanh(x @ w)
+    fn1 = lambda x, w: jnp.sin(x @ w)
+
+    def loss_bp(x, w0, w1):
+        y0, y1 = branch_parallel2(fn0, fn1, (x, w0), (x, w1), mesh)
+        return (y0**2).sum() + (y1**2).sum()
+
+    def loss_direct(x, w0, w1):
+        return (fn0(x, w0) ** 2).sum() + (fn1(x, w1) ** 2).sum()
+
+    g_bp = jax.grad(loss_bp, argnums=(0, 1, 2))(x, w0, w1)
+    g_direct = jax.grad(loss_direct, argnums=(0, 1, 2))(x, w0, w1)
+    for a, b, name in zip(g_bp, g_direct, ("x", "w0", "w1")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6,
+            err_msg=f"grad {name}",
+        )
+
+
+def test_evoformer_tracks_branch_parallel(eight_devices):
+    """The real use: one Evoformer block's MSA track and pair track as the
+    two branches (the reference's exact split, evoformer.py:281-341), on
+    actual trunk modules with params passed through the branch args."""
+    from fleetx_tpu.models.protein.evoformer import (
+        EvoformerConfig, MSARowAttentionWithPairBias, TriangleMultiplication,
+    )
+
+    cfg = EvoformerConfig(
+        msa_channel=8, pair_channel=6, num_heads_msa=2, num_heads_pair=2,
+        triangle_mult_dim=6, dtype=jnp.float32,
+    )
+    rng = np.random.RandomState(0)
+    b, s, r = 1, 3, 4
+    msa = jnp.asarray(rng.randn(b, s, r, 8), jnp.float32)
+    pair = jnp.asarray(rng.randn(b, r, r, 6), jnp.float32)
+    msa_mask = jnp.ones((b, s, r), jnp.float32)
+    pair_mask = jnp.ones((b, r, r), jnp.float32)
+
+    msa_mod = MSARowAttentionWithPairBias(cfg)
+    tri_mod = TriangleMultiplication(cfg, outgoing=True)
+    p_msa = msa_mod.init(jax.random.PRNGKey(0), msa, msa_mask, pair)
+    p_tri = tri_mod.init(jax.random.PRNGKey(1), pair, pair_mask)
+    # the trunk's output projections are zero-initialized (AlphaFold
+    # convention), which would make this comparison vacuous (0 == 0):
+    # randomize every leaf so outputs are nonzero
+    _rand = np.random.RandomState(7)
+    randomize = lambda t: jax.tree.map(
+        lambda x: jnp.asarray(_rand.randn(*x.shape), jnp.float32) * 0.3, t
+    )
+    p_msa, p_tri = randomize(p_msa), randomize(p_tri)
+
+    fn_msa = lambda p, m: msa_mod.apply(p, m, msa_mask, pair)
+    fn_tri = lambda p, z: tri_mod.apply(p, z, pair_mask)
+
+    mesh = _mesh(2)
+    y_msa, y_tri = branch_parallel2(
+        fn_msa, fn_tri, (p_msa, msa), (p_tri, pair), mesh
+    )
+    ref_msa, ref_tri = fn_msa(p_msa, msa), fn_tri(p_tri, pair)
+    assert float(jnp.abs(ref_msa).max()) > 1e-3  # non-vacuous comparison
+    assert float(jnp.abs(ref_tri).max()) > 1e-3
+    np.testing.assert_allclose(
+        np.asarray(y_msa), np.asarray(ref_msa), rtol=2e-5, atol=2e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(y_tri), np.asarray(ref_tri), rtol=2e-5, atol=2e-6
+    )
+
+
+def test_odd_axis_rejected(eight_devices):
+    devs = np.asarray(jax.devices()[:3]).reshape(3)
+    mesh = Mesh(devs, ("cp",))
+    with pytest.raises(ValueError, match="even"):
+        branch_parallel2(
+            lambda x: x, lambda x: x, (jnp.ones(2),), (jnp.ones(2),), mesh
+        )

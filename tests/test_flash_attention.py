@@ -308,12 +308,14 @@ def test_long_sequence_32k_real_tpu():
 
 def test_block_env_override_validation():
     """FLEETX_FLASH_BLOCK_Q/K are validated at import: zero, negative, or
-    non-128-multiple values must raise a descriptive error instead of a
-    ZeroDivisionError at dispatch (ADVICE r3 #4)."""
+    sublane-misaligned (non-multiple-of-8) values, and a Q/K pair where
+    block_k does not divide block_q, must raise a descriptive error instead
+    of a ZeroDivisionError or a silent XLA fallback at dispatch
+    (ADVICE r3 #4)."""
     import subprocess
     import sys
 
-    for bad in ("0", "-128", "100", "abc"):  # 100 % 8 != 0; 64 stays legal
+    for bad in ("0", "-128", "100", "abc", "64"):  # 100 % 8 != 0; 64 % 128 pair
         proc = subprocess.run(
             [sys.executable, "-c",
              "import fleetx_tpu.ops.pallas.flash_attention"],
