@@ -185,6 +185,7 @@ class Trainer:
 
         self._compiled = {}
         self._compiled_raw = {}
+        self._abstract_args = {}  # name -> (args, kwargs) avals of first call
         self._restored_step = None
         self._preempted = False
         self._prev_sigterm = None
@@ -401,15 +402,43 @@ class Trainer:
         if name not in self._compiled:
             raw = builder()
             self._compiled_raw[name] = raw  # jitted fn, for cost_analysis
-            self._compiled[name] = self._in_context(raw)
+            self._compiled[name] = self._in_context(raw, name=name)
         return self._compiled[name]
 
-    def _in_context(self, fn):
+    def cost_analysis(self, name="train"):
+        """XLA static cost model of a compiled step (flops / bytes accessed).
+
+        jax.jit wrappers expose no cost_analysis; only the AOT Compiled object
+        does. We recorded the abstract avals of the first real call, so
+        lower().compile() here is a compilation-cache hit, not a recompile."""
+        import jax
+
+        import flax.linen as nn
+
+        fn = self._compiled_raw.get(name)
+        spec = self._abstract_args.get(name)
+        if fn is None or spec is None:
+            return None
+        args, kwargs = spec
+        # same contexts as _in_context: without the logical axis rules,
+        # with_logical_constraint silently no-ops and we'd trace (and
+        # fully recompile) a differently-sharded program
+        with use_mesh(self.mesh), nn.logical_axis_rules(list(self.rules)):
+            return fn.lower(*args, **kwargs).compile().cost_analysis()
+
+    def _in_context(self, fn, name=None):
         """Run calls (and hence first-call tracing) inside the mesh + logical
         axis-rules contexts so nn.with_logical_constraint resolves."""
         import flax.linen as nn
+        import jax
+
+        def _aval(x):
+            return (jax.ShapeDtypeStruct(x.shape, x.dtype)
+                    if hasattr(x, "shape") and hasattr(x, "dtype") else x)
 
         def call(*args, **kwargs):
+            if name is not None and name not in self._abstract_args:
+                self._abstract_args[name] = jax.tree.map(_aval, (args, kwargs))
             with use_mesh(self.mesh), nn.logical_axis_rules(list(self.rules)):
                 return fn(*args, **kwargs)
 
@@ -494,6 +523,9 @@ class Trainer:
                         "preemption signal received: checkpointing at step %d "
                         "and exiting fit()", step,
                     )
+                    # close the trace first (summary deferred: the grace
+                    # window belongs to the checkpoint, not trace parsing)
+                    self._profiler_maybe_stop(summary=False)
                     self.save(epoch=epoch)
                     self.wait_for_checkpoints()
                     return
@@ -797,8 +829,12 @@ class Trainer:
             step_times,
         )
 
-    def _profiler_maybe_stop(self):
+    def _profiler_maybe_stop(self, summary: bool = True):
+        """Close an open trace window. ``summary=False`` finalizes the trace
+        only — the preemption path uses it so the SIGTERM grace window is
+        spent checkpointing, not parsing trace JSON."""
         if getattr(self, "_prof_running", False):
             jax.profiler.stop_trace()
             self._prof_running = False
-            self._print_summary()
+            if summary:
+                self._print_summary()

@@ -89,10 +89,10 @@ def _model(trainer):
         logger.info("param stats unavailable: %s", e)
     cost = None
     try:
-        # the jitted step exposes XLA's static cost model post-compile
-        jitted = trainer._compiled_raw.get("train")
-        if jitted is not None:
-            cost = jitted.cost_analysis()
+        # AOT path: jit wrappers expose no cost_analysis, only the Compiled
+        # object does — trainer.cost_analysis() re-lowers with the recorded
+        # avals (a compilation-cache hit) and asks the executable
+        cost = trainer.cost_analysis("train")
     except Exception:
         cost = None
     if cost:

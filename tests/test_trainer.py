@@ -237,6 +237,9 @@ def test_profiler_window_and_summary(tmp_path, eight_devices):
     assert "model view" in text
     assert "memory view" in text
     assert "steps profiled" in text
+    # ADVICE r3 #2: jit wrappers expose no cost_analysis — the model view
+    # must go through the AOT Compiled object (cache-hit relower)
+    assert "xla cost analysis" in text, text[:1500]
     # the jax CPU backend still writes a trace dir
     import os
 

@@ -186,7 +186,10 @@ def run(args) -> dict:
     step = 0
     pool = None
     if args.workers > 1:
-        pool = mp.Pool(args.workers, initializer=_init_worker, initargs=(args,))
+        # spawn, not fork: the parent may have initialised JAX (multithreaded),
+        # and fork-under-JAX is a documented deadlock source
+        ctx = mp.get_context("spawn")
+        pool = ctx.Pool(args.workers, initializer=_init_worker, initargs=(args,))
     else:
         _init_worker(args)
     try:
