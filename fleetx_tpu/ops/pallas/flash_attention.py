@@ -8,8 +8,8 @@ the [s, s] score matrix out of HBM entirely, so long sequences don't need the
 reference's ``recompute_granularity=core_attn`` memory workaround.
 
 Two masking modes, both resolved inside the kernels:
-- ``causal=True``: lower-triangular (GPT decoders); the k-block axis of the
-  grid stops contributing at the diagonal.
+- ``causal=True``: lower-triangular (GPT decoders); k blocks past the
+  diagonal are skipped.
 - ``kv_lens`` (optional, [batch] int32): right-padding key mask — position
   k attends only if ``k < kv_lens[b]``. This is the contiguous-padding
   form of the reference encoder's ``attention_mask`` (ernie single_model
@@ -27,20 +27,26 @@ interpreter on CPU (where pltpu.prng_* has no lowering) and on real TPUs.
 
 Layout: q, k, v are [batch, seq, heads, head_dim] (model layout).
 
-Grid-streamed K/V (this file's round-4 iteration; previously each program
-held the full K/V row in VMEM, capping per-device sequence at ~8-16k):
-every kernel runs a 3D grid whose innermost axis walks K (or Q) blocks, so
-VMEM holds only one resident block per operand plus the online-softmax
-carry in scratch — VMEM use is independent of sequence length, and Mosaic
-double-buffers the streamed blocks (DMA of block j+1 overlaps compute of
-block j). Causal skipping exploits two Pallas grid facts:
-- an input whose index_map returns the same block index on consecutive
-  steps is NOT re-fetched, so clamping the k-block index at the causal
-  diagonal makes the skipped upper-triangle steps free of HBM traffic;
-- ``pl.when`` guards the compute, so skipped steps retire immediately.
-The grid's innermost axis is sequential on TPU ("arbitrary" dimension
-semantics), which is what makes the scratch carry across k steps valid;
-(batch*head, q-block) are marked parallel for megacore partitioning.
+Major-block streaming (round-4, second iteration). Two regimes were tried:
+whole-row K/V residency (rounds 1-3) caps per-device sequence at ~8-16k
+tokens; one-grid-step-per-128-tile streaming (round 4, first cut) lifted the
+cap but regressed 1k-seq MFU 23%→15% — per-grid-step overhead swamps the
+~4 MFLOP a 128x128 online-softmax update does. This version does both:
+the grid's innermost axis streams K/V (or Q for the dK/dV kernel) in
+*major* blocks of FLEETX_FLASH_BLOCK_MAJOR rows (default 1024), and an
+in-kernel ``fori_loop`` walks the compute tiles inside the resident major
+block with an exact causal trip count. VMEM holds one major block per
+streamed operand (seq-independent; Mosaic double-buffers the stream), and
+at seq <= the major size the grid degenerates to one step per (bh, q-block)
+— the exact structure that measured MFU 23% at 1k seq. Causal skipping:
+- the streamed operand's index_map clamps at the diagonal, so skipped grid
+  steps repeat a block index and are NOT re-fetched (no HBM traffic);
+- ``pl.when`` guards the compute, so skipped steps retire immediately;
+- inside a live step the fori_loop trip count covers exactly the tiles at
+  or before the diagonal.
+The innermost grid axis is sequential on TPU ("arbitrary" dimension
+semantics), which is what makes the scratch carry across major steps valid;
+(batch*head, fixed-block) are marked parallel for megacore partitioning.
 """
 
 from __future__ import annotations
@@ -84,6 +90,9 @@ def _env_block(name: str, default: int) -> int:
 # generation (bench harness: FLEETX_FLASH_BLOCK_Q=256 python bench.py)
 DEFAULT_BLOCK_Q = _env_block("FLEETX_FLASH_BLOCK_Q", 128)
 DEFAULT_BLOCK_K = _env_block("FLEETX_FLASH_BLOCK_K", 128)
+# rows of the streamed operand resident in VMEM per grid step (the unit of
+# HBM->VMEM DMA); compute tiles walk inside it
+DEFAULT_BLOCK_MAJOR = _env_block("FLEETX_FLASH_BLOCK_MAJOR", 1024)
 if DEFAULT_BLOCK_Q % DEFAULT_BLOCK_K:
     # the dispatch-time tileability check requires block_k | block_q; catch
     # a bad override pair at import instead of silently routing every call
@@ -148,70 +157,103 @@ def _score_mask(q_pos, k_pos, kvlen, causal: bool):
     return mask
 
 
-def _last_k_block(i, block_q: int, block_k: int, causal: bool, n_k: int):
-    """Index of the last k block the i-th q block attends to."""
+def _major_block(s: int, tile: int, want: int) -> int:
+    """Largest multiple of ``tile`` that divides ``s`` and is <= want
+    (but at least ``tile``): the resident-block row count."""
+    n = s // tile
+    t = min(n, max(want // tile, 1))
+    while n % t:
+        t -= 1
+    return t * tile
+
+
+def _last_major(i, block_q: int, major: int, causal: bool, n_major: int):
+    """Index of the last K/V major block the i-th q block attends to."""
     if not causal:
-        return n_k - 1
-    return ((i + 1) * block_q) // block_k - 1
+        return n_major - 1
+    return ((i + 1) * block_q - 1) // major
 
 
-def _kv_index_map(block_q: int, block_k: int, causal: bool, n_k: int):
-    """K/V block index for grid step (bh, i, j): clamped at the causal
+def _kv_index_map(block_q: int, major: int, causal: bool, n_major: int):
+    """K/V major-block index for grid step (bh, i, jm): clamped at the causal
     diagonal so steps past it repeat the previous index (no DMA)."""
 
-    def index_map(b, i, j):
-        return b, jnp.minimum(j, _last_k_block(i, block_q, block_k, causal, n_k)), 0
+    def index_map(b, i, jm):
+        return b, jnp.minimum(jm, _last_major(i, block_q, major, causal,
+                                              n_major)), 0
 
     return index_map
 
 
 def _fwd_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, block_k: int, scale: float,
-                dropout_rate: float, causal: bool, n_k: int):
-    """Grid step (bh, q-block i, k-block j): one online-softmax update."""
+                m_scr, l_scr, acc_scr, *, block_k: int, major: int,
+                scale: float, dropout_rate: float, causal: bool,
+                n_major: int):
+    """Grid step (bh, q-block i, K/V major block jm): online-softmax updates
+    over the compute tiles inside the resident major block."""
     bq, d = q_ref.shape
     bh = pl.program_id(0)
     i = pl.program_id(1)
-    j = pl.program_id(2)
-    last_j = _last_k_block(i, bq, block_k, causal, n_k)
+    jm = pl.program_id(2)
+    last_jm = _last_major(i, bq, major, causal, n_major)
+    tiles = major // block_k
 
-    @pl.when(j == 0)
+    @pl.when(jm == 0)
     def _init():
         m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
         l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
         acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    @pl.when(j <= last_j)
+    @pl.when(jm <= last_jm)
     def _step():
         q = q_ref[:].astype(jnp.float32) * scale
         kvlen = kvlens_ref[bh]
-        k_blk = k_ref[:].astype(jnp.float32)
-        v_blk = v_ref[:].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, block_k]
         q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-        s = jnp.where(_score_mask(q_pos, k_pos, kvlen, causal), s, NEG_INF)
 
-        m = m_scr[:]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        # fully-masked rows: keep p exactly 0 (avoids exp(NEG-NEG)=1 garbage
-        # rows feeding dV through p in the backward kernels)
-        p = jnp.where(s > NEG_INF / 2, p, 0.0)
-        alpha = jnp.exp(m - m_new)
-        # The softmax normalizer sums the *undropped* probabilities; dropout
-        # scales only the value-weighted path (out = dropout(softmax(s)) @ v).
-        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
-        if dropout_rate > 0.0:
-            p = p * dropout_keep_scale(seed_ref[0], bh, q_pos, k_pos, dropout_rate)
-        acc_scr[:] = alpha * acc_scr[:] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        def body(t, carry):
+            m, l, acc = carry
+            k_blk = k_ref[pl.ds(t * block_k, block_k), :].astype(jnp.float32)
+            v_blk = v_ref[pl.ds(t * block_k, block_k), :].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [bq, block_k]
+            k_pos = (jm * major + t * block_k
+                     + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
+            s = jnp.where(_score_mask(q_pos, k_pos, kvlen, causal), s, NEG_INF)
+
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            # fully-masked rows: keep p exactly 0 (avoids exp(NEG-NEG)=1
+            # garbage rows feeding dV through p in the backward kernels)
+            p = jnp.where(s > NEG_INF / 2, p, 0.0)
+            alpha = jnp.exp(m - m_new)
+            # The softmax normalizer sums the *undropped* probabilities;
+            # dropout scales only the value path (out = drop(softmax(s)) @ v).
+            l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+            if dropout_rate > 0.0:
+                p = p * dropout_keep_scale(seed_ref[0], bh, q_pos, k_pos,
+                                           dropout_rate)
+            acc_new = alpha * acc + jax.lax.dot_general(
+                p, v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc_new
+
+        if causal:
+            # exact tile count at/before the diagonal inside this major block
+            n_inner = jnp.clip(((i + 1) * bq - jm * major) // block_k,
+                               0, tiles)
+        else:
+            n_inner = tiles
+        m, l, acc = jax.lax.fori_loop(
+            0, n_inner, body, (m_scr[:], l_scr[:], acc_scr[:])
         )
-        m_scr[:] = m_new
+        m_scr[:] = m
+        l_scr[:] = l
+        acc_scr[:] = acc
 
-    @pl.when(j == last_j)
+    @pl.when(jm == last_jm)
     def _finalize():
         l = l_scr[:]
         l_safe = jnp.where(l > 0.0, l, 1.0)  # fully-masked rows emit zeros
@@ -220,122 +262,157 @@ def _fwd_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _bwd_dq_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                   delta_ref, dq_ref, dq_scr, *, block_k: int, scale: float,
-                   dropout_rate: float, causal: bool, n_k: int):
+                   delta_ref, dq_ref, dq_scr, *, block_k: int, major: int,
+                   scale: float, dropout_rate: float, causal: bool,
+                   n_major: int):
     bq, d = q_ref.shape
     bh = pl.program_id(0)
     i = pl.program_id(1)
-    j = pl.program_id(2)
-    last_j = _last_k_block(i, bq, block_k, causal, n_k)
+    jm = pl.program_id(2)
+    last_jm = _last_major(i, bq, major, causal, n_major)
+    tiles = major // block_k
 
-    @pl.when(j == 0)
+    @pl.when(jm == 0)
     def _init():
         dq_scr[:] = jnp.zeros(dq_scr.shape, jnp.float32)
 
-    @pl.when(j <= last_j)
+    @pl.when(jm <= last_jm)
     def _step():
         q = q_ref[:].astype(jnp.float32) * scale
         do = do_ref[:].astype(jnp.float32)
         lse = lse_ref[:]      # [bq, 1]
         delta = delta_ref[:]  # [bq, 1]
         kvlen = kvlens_ref[bh]
-        k_blk = k_ref[:].astype(jnp.float32)
-        v_blk = v_ref[:].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
         q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-        mask = _score_mask(q_pos, k_pos, kvlen, causal)
-        s = jnp.where(mask, s, NEG_INF)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        if dropout_rate > 0.0:
-            # dP = (dO @ V^T) ∘ mask; delta already equals rowsum(P ∘ dP)
-            # because delta = rowsum(dO ∘ O) and O = (P ∘ mask) @ V.
-            dp = dp * dropout_keep_scale(seed_ref[0], bh, q_pos, k_pos, dropout_rate)
-        ds = p * (dp - delta)
-        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
 
-    @pl.when(j == last_j)
+        def body(t, dq):
+            k_blk = k_ref[pl.ds(t * block_k, block_k), :].astype(jnp.float32)
+            v_blk = v_ref[pl.ds(t * block_k, block_k), :].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            k_pos = (jm * major + t * block_k
+                     + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
+            mask = _score_mask(q_pos, k_pos, kvlen, causal)
+            s = jnp.where(mask, s, NEG_INF)
+            p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+            dp = jax.lax.dot_general(
+                do, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if dropout_rate > 0.0:
+                # dP = (dO @ V^T) ∘ mask; delta already equals rowsum(P ∘ dP)
+                # because delta = rowsum(dO ∘ O) and O = (P ∘ mask) @ V.
+                dp = dp * dropout_keep_scale(seed_ref[0], bh, q_pos, k_pos,
+                                             dropout_rate)
+            ds = p * (dp - delta)
+            return dq + jax.lax.dot_general(
+                ds, k_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        if causal:
+            n_inner = jnp.clip(((i + 1) * bq - jm * major) // block_k,
+                               0, tiles)
+        else:
+            n_inner = tiles
+        dq_scr[:] = jax.lax.fori_loop(0, n_inner, body, dq_scr[:])
+
+    @pl.when(jm == last_jm)
     def _finalize():
         dq_ref[:] = (dq_scr[:] * scale).astype(dq_ref.dtype)
 
 
-def _first_q_block(j, block_q: int, block_k: int, causal: bool):
-    """Index of the first q block that sees the j-th k block."""
+def _first_major(j, block_k: int, major: int, causal: bool):
+    """Index of the first Q major block that sees the j-th k block."""
     if not causal:
         return 0
-    return (j * block_k) // block_q
+    return (j * block_k) // major
 
 
-def _q_stream_index_map(block_q: int, block_k: int, causal: bool):
-    """Q-side block index for dkv grid step (bh, j, ii): clamped below at
-    the causal diagonal so pre-diagonal steps repeat one index (no DMA)."""
+def _q_stream_index_map(block_k: int, major: int, causal: bool):
+    """Q-side major-block index for dkv grid step (bh, j, im): clamped below
+    at the causal diagonal so pre-diagonal steps repeat one index (no DMA)."""
 
-    def index_map(b, j, ii):
-        return b, jnp.maximum(ii, _first_q_block(j, block_q, block_k, causal)), 0
+    def index_map(b, j, im):
+        return b, jnp.maximum(im, _first_major(j, block_k, major, causal)), 0
 
     return index_map
 
 
 def _bwd_dkv_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    block_q: int, scale: float, dropout_rate: float,
-                    causal: bool, n_q: int):
+                    block_q: int, major: int, scale: float,
+                    dropout_rate: float, causal: bool, n_major: int):
     bk, d = k_ref.shape
     bh = pl.program_id(0)
     j = pl.program_id(1)
-    ii = pl.program_id(2)
-    first_i = _first_q_block(j, block_q, bk, causal)
+    im = pl.program_id(2)
+    first_im = _first_major(j, bk, major, causal)
+    tiles = major // block_q
 
-    @pl.when(ii == 0)
+    @pl.when(im == 0)
     def _init():
         dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
         dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
 
-    @pl.when(ii >= first_i)
+    @pl.when(im >= first_im)
     def _step():
         k = k_ref[:].astype(jnp.float32)
         v = v_ref[:].astype(jnp.float32)
         kvlen = kvlens_ref[bh]
-        q_blk = q_ref[:].astype(jnp.float32) * scale
-        do_blk = do_ref[:].astype(jnp.float32)
-        lse = lse_ref[:]      # [block_q, 1]
-        delta = delta_ref[:]  # [block_q, 1]
-        s = jax.lax.dot_general(
-            q_blk, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        q_pos = ii * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, bk), 0)
         k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-        mask = _score_mask(q_pos, k_pos, kvlen, causal)
-        s = jnp.where(mask, s, NEG_INF)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-        dp = jax.lax.dot_general(
-            do_blk, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        if dropout_rate > 0.0:
-            drop = dropout_keep_scale(seed_ref[0], bh, q_pos, k_pos, dropout_rate)
-            p_v = p * drop  # dropped probabilities feed dV
-            dp = dp * drop
-        else:
-            p_v = p
-        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p_v, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta)
-        # q blocks were loaded pre-scaled, so the chain rule's `scale`
-        # factor is already inside `ds @ q_scaled`
-        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
 
-    @pl.when(ii == n_q - 1)
+        def body(t, carry):
+            dk, dv = carry
+            q_blk = q_ref[pl.ds(t * block_q, block_q), :].astype(jnp.float32) * scale
+            do_blk = do_ref[pl.ds(t * block_q, block_q), :].astype(jnp.float32)
+            lse = lse_ref[pl.ds(t * block_q, block_q), :]      # [block_q, 1]
+            delta = delta_ref[pl.ds(t * block_q, block_q), :]  # [block_q, 1]
+            s = jax.lax.dot_general(
+                q_blk, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            q_pos = (im * major + t * block_q
+                     + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0))
+            mask = _score_mask(q_pos, k_pos, kvlen, causal)
+            s = jnp.where(mask, s, NEG_INF)
+            p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+            dp = jax.lax.dot_general(
+                do_blk, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if dropout_rate > 0.0:
+                drop = dropout_keep_scale(seed_ref[0], bh, q_pos, k_pos,
+                                          dropout_rate)
+                p_v = p * drop  # dropped probabilities feed dV
+                dp = dp * drop
+            else:
+                p_v = p
+            dv_new = dv + jax.lax.dot_general(
+                p_v, do_blk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta)
+            # q tiles were loaded pre-scaled, so the chain rule's `scale`
+            # factor is already inside `ds @ q_scaled`
+            dk_new = dk + jax.lax.dot_general(
+                ds, q_blk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return dk_new, dv_new
+
+        if causal:
+            # first q tile inside this major block at/after the diagonal
+            t0 = jnp.clip((j * bk) // block_q - im * tiles, 0, tiles)
+        else:
+            t0 = 0
+        dk, dv = jax.lax.fori_loop(t0, tiles, body, (dk_scr[:], dv_scr[:]))
+        dk_scr[:] = dk
+        dv_scr[:] = dv
+
+    @pl.when(im == n_major - 1)
     def _finalize():
         dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
@@ -359,28 +436,29 @@ def _seed_spec():
 def _fwd_call(seed, kvlens, q3, k3, v3, block_q, block_k, scale, dropout_rate,
               causal):
     bh, s, d = q3.shape
-    n_k = s // block_k
-    grid = (bh, s // block_q, n_k)
+    major = _major_block(s, block_k, DEFAULT_BLOCK_MAJOR)
+    n_major = s // major
+    grid = (bh, s // block_q, n_major)
     kernel = functools.partial(
-        _fwd_kernel, block_k=block_k, scale=scale, dropout_rate=dropout_rate,
-        causal=causal, n_k=n_k,
+        _fwd_kernel, block_k=block_k, major=major, scale=scale,
+        dropout_rate=dropout_rate, causal=causal, n_major=n_major,
     )
-    kv_map = _kv_index_map(block_q, block_k, causal, n_k)
+    kv_map = _kv_index_map(block_q, major, causal, n_major)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             _seed_spec(),
             _seed_spec(),
-            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_k, d), kv_map),
-            pl.BlockSpec((None, block_k, d), kv_map),
+            pl.BlockSpec((None, block_q, d), lambda b, i, jm: (b, i, 0)),
+            pl.BlockSpec((None, major, d), kv_map),
+            pl.BlockSpec((None, major, d), kv_map),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, jm: (b, i, 0)),
             # trailing singleton dim: Mosaic requires the last block dim to
             # divide 128 or equal the array dim — (block_q, 1) satisfies it
-            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, jm: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
@@ -417,56 +495,59 @@ def _flash_bwd(block_q, block_k, dropout_rate, causal, res, g):
     q3, k3, v3, o3, lse, seed, kvlens, b, h = res
     bh, s, d = q3.shape
     scale = 1.0 / (d**0.5)
-    n_k = s // block_k
-    n_q = s // block_q
     do3 = _to_bh(g)
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1,
                     keepdims=True)  # [bh, s, 1]
 
-    kv_map = _kv_index_map(block_q, block_k, causal, n_k)
+    kv_major = _major_block(s, block_k, DEFAULT_BLOCK_MAJOR)
+    n_kv_major = s // kv_major
+    kv_map = _kv_index_map(block_q, kv_major, causal, n_kv_major)
     dq3 = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, block_k=block_k, scale=scale,
-            dropout_rate=dropout_rate, causal=causal, n_k=n_k,
+            _bwd_dq_kernel, block_k=block_k, major=kv_major, scale=scale,
+            dropout_rate=dropout_rate, causal=causal, n_major=n_kv_major,
         ),
-        grid=(bh, n_q, n_k),
+        grid=(bh, s // block_q, n_kv_major),
         in_specs=[
             _seed_spec(),
             _seed_spec(),
-            pl.BlockSpec((None, block_q, d), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((None, block_k, d), kv_map),
-            pl.BlockSpec((None, block_k, d), kv_map),
-            pl.BlockSpec((None, block_q, d), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b_, i, jm: (b_, i, 0)),
+            pl.BlockSpec((None, kv_major, d), kv_map),
+            pl.BlockSpec((None, kv_major, d), kv_map),
+            pl.BlockSpec((None, block_q, d), lambda b_, i, jm: (b_, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b_, i, jm: (b_, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b_, i, jm: (b_, i, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda b_, i, j: (b_, i, 0)),
+        out_specs=pl.BlockSpec((None, block_q, d),
+                               lambda b_, i, jm: (b_, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(seed, kvlens, q3, k3, v3, do3, lse, delta)
 
-    q_map = _q_stream_index_map(block_q, block_k, causal)
+    q_major = _major_block(s, block_q, DEFAULT_BLOCK_MAJOR)
+    n_q_major = s // q_major
+    q_map = _q_stream_index_map(block_k, q_major, causal)
     dk3, dv3 = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, block_q=block_q, scale=scale,
-            dropout_rate=dropout_rate, causal=causal, n_q=n_q,
+            _bwd_dkv_kernel, block_q=block_q, major=q_major, scale=scale,
+            dropout_rate=dropout_rate, causal=causal, n_major=n_q_major,
         ),
-        grid=(bh, n_k, n_q),
+        grid=(bh, s // block_k, n_q_major),
         in_specs=[
             _seed_spec(),
             _seed_spec(),
-            pl.BlockSpec((None, block_q, d), q_map),
-            pl.BlockSpec((None, block_k, d), lambda b_, j, ii: (b_, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b_, j, ii: (b_, j, 0)),
-            pl.BlockSpec((None, block_q, d), q_map),
-            pl.BlockSpec((None, block_q, 1), q_map),
-            pl.BlockSpec((None, block_q, 1), q_map),
+            pl.BlockSpec((None, q_major, d), q_map),
+            pl.BlockSpec((None, block_k, d), lambda b_, j, im: (b_, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b_, j, im: (b_, j, 0)),
+            pl.BlockSpec((None, q_major, d), q_map),
+            pl.BlockSpec((None, q_major, 1), q_map),
+            pl.BlockSpec((None, q_major, 1), q_map),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_k, d), lambda b_, j, ii: (b_, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b_, j, ii: (b_, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b_, j, im: (b_, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b_, j, im: (b_, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), k3.dtype),
