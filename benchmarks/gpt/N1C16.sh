@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# 16-device topology grid (reference test_tipc N4C32 analogue, lower rung).
+# Default: 16-device virtual CPU mesh — a topology/convergence gate, not a
+# perf number. On a real >=16-chip slice: BENCH_MATRIX_PLATFORM=tpu $0
+cd "$(dirname "$0")/../.."
+python tools/bench_matrix.py --devices 16 --out "${1:-bench_n1c16.json}"

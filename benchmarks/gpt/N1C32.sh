@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# 32-device topology grid (reference test_tipc N4C32 entries: 4 hosts x 8
+# cards; here one 32-device virtual mesh — same global topologies, ICI/DCN
+# split left to GSPMD). DP2-MP2-PP2-Sharding4-Stage2 is the reference's
+# flagship N4C32 hybrid case.
+cd "$(dirname "$0")/../.."
+# default: 32-device virtual CPU mesh (topology/convergence gate); on a
+# real >=32-chip slice: BENCH_MATRIX_PLATFORM=tpu $0
+python tools/bench_matrix.py --devices 32 --out "${1:-bench_n1c32.json}"

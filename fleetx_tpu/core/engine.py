@@ -57,11 +57,14 @@ def make_grad_fn(module: "BasicModule", accum: int):
     reference's host-side micro-batch loop (eager_engine.py:442-483)."""
 
     def loss_for_micro(params, micro, rng):
-        # central QAT hook: STE fake-quant INSIDE the grad computation so
-        # every module family quantizes identically (no per-module wiring)
-        loss, metrics = module.loss_fn(
-            module.maybe_fake_quant(params), micro, rng, train=True
-        )
+        # central QAT hooks: STE weight fake-quant INSIDE the grad
+        # computation, and (when configured) activation fake-quant on every
+        # Dense input via the module's interceptor context — so every module
+        # family quantizes identically (no per-module wiring)
+        with module.act_quant_ctx():
+            loss, metrics = module.loss_fn(
+                module.maybe_fake_quant(params), micro, rng, train=True
+            )
         return loss, metrics
 
     grad_fn = jax.value_and_grad(loss_for_micro, has_aux=True)
@@ -101,9 +104,10 @@ def make_grad_fn_extra(module: "BasicModule", accum: int):
         )
 
     def loss_for(params, extra, batch, rng):
-        loss, aux, new_extra = module.loss_fn_extra(
-            module.maybe_fake_quant(params), extra, batch, rng, train=True
-        )
+        with module.act_quant_ctx():
+            loss, aux, new_extra = module.loss_fn_extra(
+                module.maybe_fake_quant(params), extra, batch, rng, train=True
+            )
         return loss, (aux, new_extra)
 
     grad_fn = jax.value_and_grad(loss_for, has_aux=True)
@@ -382,12 +386,14 @@ class Trainer:
 
         def eval_step(state: TrainState, batch):
             params = module.maybe_fake_quant(state.params)
-            if state.extra is not None:
-                loss, metrics, _ = module.loss_fn_extra(
-                    params, state.extra, batch, None, train=False
-                )
-            else:
-                loss, metrics = module.loss_fn(params, batch, None, train=False)
+            with module.act_quant_ctx():
+                if state.extra is not None:
+                    loss, metrics, _ = module.loss_fn_extra(
+                        params, state.extra, batch, None, train=False
+                    )
+                else:
+                    loss, metrics = module.loss_fn(params, batch, None,
+                                                   train=False)
             return {"loss": loss, **metrics}
 
         sh = self._state_sharding_tree
@@ -635,7 +641,8 @@ class Trainer:
             module = self.module
 
             def predict_step(state: TrainState, feed):
-                return fwd(module.maybe_fake_quant(state.params), feed)
+                with module.act_quant_ctx():
+                    return fwd(module.maybe_fake_quant(state.params), feed)
 
             batch_sh = NamedSharding(self.mesh, P(DATA_AXES))
             return jax.jit(

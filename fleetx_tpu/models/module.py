@@ -25,6 +25,8 @@ class BasicModule:
         q = (cfg.get("Quantization") or {}) if hasattr(cfg, "get") else {}
         self.quant_enabled = bool(q.get("enable"))
         self.quant_bits = int(q.get("weight_bits") or 8)
+        self.quant_act = bool(q.get("activation_quantize_type"))
+        self.act_bits = int(q.get("activation_bits") or 8)
         if self.quant_enabled:
             from fleetx_tpu.utils.log import logger
 
@@ -33,11 +35,44 @@ class BasicModule:
                 logger.warning(
                     "weight_quantize_type=%r unsupported; using per-channel "
                     "abs_max", wqt)
-            if q.get("activation_quantize_type"):
+            aqt = q.get("activation_quantize_type")
+            if aqt and aqt not in ("abs_max", "moving_average_abs_max"):
                 logger.warning(
-                    "activation quantization (%r) is not implemented — QAT "
-                    "here is weight-only", q["activation_quantize_type"])
+                    "activation_quantize_type=%r unsupported; using dynamic "
+                    "abs_max", aqt)
+            elif aqt == "moving_average_abs_max":
+                logger.info(
+                    "activation QAT uses dynamic per-tensor abs_max; the "
+                    "moving average's purpose (static serving scales) does "
+                    "not apply to the weight-only int8 export")
         self.nets = self.get_model()
+
+    def act_quant_ctx(self):
+        """Context manager fake-quantizing every nn.Dense INPUT during the
+        wrapped apply (paddleslim activation QAT: observers on
+        quantizable_layer_type=Linear inputs, reference
+        qat_gpt_345M_mp8.yaml). A flax method interceptor keeps it
+        model-family-agnostic — no per-model wiring, works under jit since
+        interception happens at trace time. Identity context when disabled."""
+        import contextlib
+
+        if not (self.quant_enabled and self.quant_act):
+            return contextlib.nullcontext()
+        import flax.linen as nn
+
+        from fleetx_tpu.ops.quant import fake_quant_act
+
+        # paddleslim quantizable_layer_type = Conv2D + Linear (+ the mp
+        # parallel Linears, which GSPMD folds into the same DenseGeneral)
+        quantizable = (nn.Dense, nn.DenseGeneral, nn.Conv)
+
+        def interceptor(next_fun, args, kwargs, context):
+            if (isinstance(context.module, quantizable)
+                    and context.method_name == "__call__" and args):
+                args = (fake_quant_act(args[0], self.act_bits),) + args[1:]
+            return next_fun(*args, **kwargs)
+
+        return nn.intercept_methods(interceptor)
 
     def maybe_fake_quant(self, params):
         """Fake-quantize eligible weights for QAT; identity otherwise."""

@@ -58,6 +58,26 @@ def fake_quant(w: jax.Array, bits: int = 8, axis: int = -1):
     return (w32 + jax.lax.stop_gradient(deq - w32)).astype(w.dtype)
 
 
+def fake_quant_act(x: jax.Array, bits: int = 8) -> jax.Array:
+    """Activation fake-quant: per-tensor DYNAMIC absmax (paddleslim
+    ``abs_max`` activation observer), straight-through gradient.
+
+    Per-tensor (not per-channel) matches quantized-serving kernels, which
+    need one scale per activation tensor; dynamic (recomputed each step
+    from the live tensor) is the jit-native form — no observer state
+    threaded through the train step. The reference default
+    ``moving_average_abs_max`` exists to accumulate *static serving
+    scales*; our int8 export is weight-only (activations stay float at
+    serving), so training-time dynamic scales carry the same QAT signal
+    without the EMA state."""
+    maxq = 2 ** (bits - 1) - 1
+    x32 = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x32))
+    scale = jnp.maximum(absmax / maxq, 1e-12)
+    deq = jnp.clip(jnp.round(x32 / scale), -maxq, maxq) * scale
+    return (x32 + jax.lax.stop_gradient(deq - x32)).astype(x.dtype)
+
+
 def _is_weight(path, leaf) -> bool:
     """Dense/conv kernels only: >=2-D and named kernel/embedding-ish."""
     if not hasattr(leaf, "ndim") or leaf.ndim < 2:

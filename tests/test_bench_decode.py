@@ -1,0 +1,28 @@
+"""Decode-throughput bench harness smoke (tiny model, schema + liveness).
+
+The real numbers come from the TPU run (bench.py folds them into the
+anchor record's detail.extra_records); this test proves the harness itself
+— jitted prefill+while_loop decode for greedy and beam — produces finite
+throughput records with the documented schema."""
+
+import importlib
+
+import numpy as np
+
+
+def test_decode_records_schema(monkeypatch, eight_devices):
+    monkeypatch.setenv("BENCH_DECODE_TINY", "1")
+    import tools.bench_decode as bd
+
+    bd = importlib.reload(bd)  # re-read the _TINY env gate
+    recs = bd.decode_records(modes=("greedy", "beam"), batches=(1, 2),
+                             steps=1)
+    assert [r["metric"] for r in recs] == [
+        "gpt_345m_decode_greedy_b1", "gpt_345m_decode_greedy_b2",
+        "gpt_345m_decode_beam_b1", "gpt_345m_decode_beam_b2",
+    ]
+    for r in recs:
+        assert r["unit"] == "tokens/s"
+        assert np.isfinite(r["value"]) and r["value"] > 0
+        assert r["detail"]["gen_len"] == 8
+    assert recs[2]["detail"]["num_beams"] == 4

@@ -33,3 +33,24 @@ def test_two_case_grid(monkeypatch, tmp_path):
     for rec in grid["results"]:
         assert rec["ips_tokens_per_s"] > 0
         assert np.isfinite(rec["loss_last"])
+
+
+def test_case_grids_factor_their_device_counts():
+    """Every N1C16/N1C32 case's degree product must equal the device count
+    (the same check init_dist_env enforces at launch), so entry scripts
+    can't ship a topology the mesh would reject."""
+    for n, cases in bench_matrix.cases_by_devices().items():
+        for name, ov in cases.items():
+            product = (
+                ov.get("Distributed.dp_degree", 1)
+                * ov.get("Distributed.mp_degree", 1)
+                * ov.get("Distributed.pp_degree", 1)
+                * ov.get("Distributed.cp_degree", 1)
+                * ov.get("Distributed.sharding.sharding_degree", 1)
+            )
+            assert product == n, (name, product, n)
+
+
+def test_unknown_device_count_rejected():
+    with pytest.raises(SystemExit):
+        bench_matrix.main(["--devices", "7"])

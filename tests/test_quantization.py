@@ -123,3 +123,54 @@ def test_qat_config_zoo_builds():
         assert cfg.Quantization.enable
         module = build_module(cfg)
         assert module.quant_enabled and module.quant_bits == 8
+
+
+def test_act_quant_interceptor_changes_forward(tmp_path, eight_devices):
+    """With activation_quantize_type set, the Dense-input interceptor must
+    actually engage: the quantized-forward loss differs from the weight-only
+    QAT loss, and training still converges (VERDICT r3 item 8)."""
+    from fleetx_tpu.core.engine import Trainer
+    from fleetx_tpu.models import build_module
+    import fleetx_tpu.parallel.env as dist_env
+
+    rng = np.random.RandomState(0)
+    tokens = ((np.arange(16)[None, :] + rng.randint(0, 64, (4, 1))) % 64)
+    batch = {
+        "tokens": tokens.astype(np.int32),
+        "labels": ((tokens + 1) % 64).astype(np.int32),
+        "loss_mask": np.ones((4, 16), np.float32),
+    }
+
+    def first_loss_and_curve(act):
+        cfg = _tiny_qat_cfg(tmp_path)
+        if act:
+            cfg.Quantization.activation_quantize_type = "abs_max"
+            cfg.Quantization.activation_bits = 8
+        module = build_module(cfg)
+        assert module.quant_act is act
+        trainer = Trainer(cfg, module)
+        trainer.init_state(batch)
+        step = trainer._get("train", trainer._build_train_step)
+        db = trainer._shard_batch(batch)
+        losses = []
+        state = trainer.state
+        for i in range(12):
+            state, m = step(state, db, dist_env.data_rank_key(i))
+            losses.append(float(m["loss"]))
+        return losses
+
+    weight_only = first_loss_and_curve(False)
+    act_quant = first_loss_and_curve(True)
+    # same seed/init => any difference comes from the activation fake-quant
+    assert act_quant[0] != weight_only[0]
+    assert np.isfinite(act_quant).all()
+    assert act_quant[-1] < act_quant[0] - 0.3, act_quant
+
+
+def test_act_qat_config_builds():
+    from fleetx_tpu.models import build_module
+
+    cfg = get_config("configs/nlp/gpt/qat_gpt_345M_mp8_act.yaml", nranks=8)
+    module = build_module(cfg)
+    assert module.quant_enabled and module.quant_act
+    assert module.act_bits == 8

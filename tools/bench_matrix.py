@@ -58,6 +58,39 @@ CASES_1 = {
     "DP1-MP1-PP1": {"Distributed.dp_degree": 1},
 }
 
+# N4C32-analogue grids (reference ships N1C1/N1C8/N4C32 test_tipc entries;
+# here 16/32 virtual devices stand in for the 4-host topology — same mesh
+# factors as __graft_entry__.dryrun_multichip's 16/32-device table)
+CASES_16 = {
+    "DP16-MP1-PP1": {"Distributed.dp_degree": 16},
+    "DP4-MP2-PP2": {"Distributed.dp_degree": 4, "Distributed.mp_degree": 2,
+                    "Distributed.pp_degree": 2},
+    "DP2-MP2-PP2-Sharding2-Stage2": {
+        "Distributed.dp_degree": 2, "Distributed.mp_degree": 2,
+        "Distributed.pp_degree": 2,
+        "Distributed.sharding.sharding_degree": 2,
+        "Distributed.sharding.sharding_stage": 2,
+    },
+    "DP8-CP2": {"Distributed.dp_degree": 8, "Distributed.cp_degree": 2,
+                "Model.attention_probs_dropout_prob": 0.0},
+}
+CASES_32 = {
+    "DP32-MP1-PP1": {"Distributed.dp_degree": 32},
+    "DP8-MP2-PP2": {"Distributed.dp_degree": 8, "Distributed.mp_degree": 2,
+                    "Distributed.pp_degree": 2},
+    "DP2-MP2-PP2-Sharding4-Stage2": {
+        "Distributed.dp_degree": 2, "Distributed.mp_degree": 2,
+        "Distributed.pp_degree": 2,
+        "Distributed.sharding.sharding_degree": 4,
+        "Distributed.sharding.sharding_stage": 2,
+    },
+}
+
+def cases_by_devices():
+    """Resolved at call time (not import) so tests can monkeypatch the
+    per-count grids."""
+    return {1: CASES_1, 8: CASES_8, 16: CASES_16, 32: CASES_32}
+
 
 def make_dataset(tmp: str, vocab: int = 120) -> str:  # < tiny config vocab_size=128
     rng = np.random.RandomState(0)
@@ -86,7 +119,11 @@ def run_case(name, overrides, args, data_prefix, tmp):
     # the parsed ips:/loss: lines log at INFO/TRAIN level; a quieter
     # inherited level (e.g. the test conftest) would blank the log
     env["FLEETX_LOG_LEVEL"] = "INFO"
-    if args.devices > 1:
+    # default: virtual CPU mesh (topology/convergence gate, not a perf
+    # number). BENCH_MATRIX_PLATFORM=tpu runs the cases on a real slice
+    # with >= --devices chips (reference test_tipc measures real perf).
+    if args.devices > 1 and os.environ.get(
+            "BENCH_MATRIX_PLATFORM", "cpu") == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = (
             env.get("XLA_FLAGS", "")
@@ -135,7 +172,14 @@ def main(argv=None):
     ap.add_argument("--out", default=None, help="write the grid json here")
     args = ap.parse_args(argv)
 
-    cases = CASES_1 if args.devices == 1 else CASES_8
+    grids = cases_by_devices()
+    try:
+        cases = grids[args.devices]
+    except KeyError:
+        raise SystemExit(
+            f"no case grid for --devices {args.devices} "
+            f"(have {sorted(grids)})"
+        )
     results = []
     with tempfile.TemporaryDirectory() as tmp:
         data_prefix = make_dataset(tmp)
