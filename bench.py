@@ -180,7 +180,48 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
     return rec
 
 
+def _decode_records_subprocess(timeout_s: int):
+    """Serving bench in a CHILD process with a hard timeout, run BEFORE the
+    parent touches the TPU (the chip is exclusive: two live processes can't
+    both hold it, and an in-process compile hang would sink the anchor
+    record — the driver contract is one JSON line, printed at the end)."""
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools", "bench_decode.py")],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return [{"metric": "gpt_345m_decode",
+                 "error": f"timeout after {timeout_s}s"}]
+    recs = []
+    for line in proc.stdout.splitlines():
+        if line.startswith("{"):
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                pass
+    if proc.returncode != 0:
+        # surface the failure even when some modes printed before the crash
+        # (partial greedy records must not read as a complete decode bench)
+        recs.append({"metric": "gpt_345m_decode",
+                     "error": f"rc={proc.returncode}: {proc.stderr[-500:]}"})
+    elif not recs:
+        recs = [{"metric": "gpt_345m_decode",
+                 "error": "no records in child stdout"}]
+    return recs
+
+
 def main():
+    extras = []
+    if os.environ.get("BENCH_EXTRA", "1") != "0":
+        # decode first: the child must own the chip before the parent does
+        extras.extend(_decode_records_subprocess(
+            int(os.environ.get("BENCH_DECODE_TIMEOUT", 900))))
+
     _acquire_devices_or_die(int(os.environ.get("BENCH_INIT_TIMEOUT", 300)))
 
     seq = int(os.environ.get("BENCH_SEQ", 1024))
@@ -198,7 +239,6 @@ def main():
     anchor = train_record(batch, seq=seq, steps=steps, warmup=warmup,
                           recompute=recompute, granularity=granularity)
 
-    extras = []
     if os.environ.get("BENCH_EXTRA", "1") != "0":
         second = int(os.environ.get("BENCH_SECOND_BATCH", 16))
         if second != batch:
@@ -212,12 +252,6 @@ def main():
             except Exception as e:  # e.g. OOM at 2x batch: keep the anchor
                 extras.append({"metric": f"gpt_345m_pretrain_b{second}",
                                "error": repr(e)})
-        try:
-            from tools.bench_decode import decode_records
-
-            extras.extend(decode_records())
-        except Exception as e:  # decode bench must not sink the anchor
-            extras.append({"metric": "gpt_345m_decode", "error": repr(e)})
     if extras:
         anchor["detail"]["extra_records"] = extras
     print(json.dumps(anchor))

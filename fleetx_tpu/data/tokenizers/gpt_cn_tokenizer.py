@@ -1,0 +1,171 @@
+"""Chinese GPT (CPM) tokenizer: sentencepiece-unigram in pure Python.
+
+Reference: GPTChineseTokenizer ("gpt-cpm-large-cn"), selected by the GPT-cn
+model class (/root/reference/ppfleetx/data/dataset/gpt_dataset.py:35-39).
+The reference depends on the `sentencepiece` C++ wheel + `jieba`; neither
+ships in this image, and the CPM .model file itself cannot be fetched under
+zero egress. TPU-first replacement: the sentencepiece **model protobuf** is
+parsed with the pb2 schema transformers already bundles, and unigram
+segmentation is a plain Viterbi pass over the piece scores — so any
+user-supplied `.model` file works with zero native dependencies.
+
+CPM pre-segments text with jieba before sentencepiece (word-granularity
+hints); jieba is pure Python and present in this image, so that path runs
+by default. If jieba is ever absent, text goes straight to the unigram
+model — different segmentation granularity, same vocabulary and decode
+mapping.
+CPM's whitespace conventions are kept: ' ' -> '▂', '\n' -> '▃' before
+encoding, inverted after decoding, and the '▁' word-boundary markers the
+space-joined segmentation introduces are dropped on decode.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+__all__ = ["SentencePieceUnigram", "GPTChineseTokenizer"]
+
+_SPACE = "▂"    # ▂  CPM space placeholder
+_NEWLINE = "▃"  # ▃  CPM newline placeholder
+_WORD_SEP = "▁"  # ▁  sentencepiece word-boundary marker
+
+
+class SentencePieceUnigram:
+    """Unigram-LM sentencepiece encoder over a parsed ModelProto.
+
+    Viterbi over piece log-probs: best[i] = max_j best[j] + score(text[j:i]).
+    Characters no piece covers fall back to the model's unk id (score from
+    trainer_spec, default well below any real piece so unk never beats a
+    genuine segmentation).
+    """
+
+    def __init__(self, pieces: Dict[str, float], ids: Dict[str, int],
+                 unk_id: int = 0, unk_piece: str = "<unk>",
+                 escape_whitespaces: bool = True):
+        self.scores = pieces
+        self.ids = ids
+        self.id_to_piece = {i: p for p, i in ids.items()}
+        self.unk_id = unk_id
+        self.unk_piece = unk_piece
+        # sentencepiece normalization: spaces become the ▁ meta symbol
+        # BEFORE segmentation (normalizer_spec.escape_whitespaces)
+        self.escape_whitespaces = escape_whitespaces
+        self.max_piece_len = max((len(p) for p in pieces), default=1)
+        # unk must stay strictly worse than any real single piece
+        self.unk_score = min(pieces.values(), default=0.0) - 10.0
+        self.eos_id: Optional[int] = None  # set by from_file when present
+
+    @classmethod
+    def from_file(cls, model_file: str) -> "SentencePieceUnigram":
+        from transformers.utils import sentencepiece_model_pb2_new as pb2
+
+        proto = pb2.ModelProto()
+        with open(model_file, "rb") as f:
+            proto.ParseFromString(f.read())
+        pieces: Dict[str, float] = {}
+        ids: Dict[str, int] = {}
+        unk_id, unk_piece = 0, "<unk>"
+        eos_id: Optional[int] = None
+        for i, p in enumerate(proto.pieces):
+            ids[p.piece] = i
+            if p.piece in ("</s>", "<eod>") and eos_id is None:
+                eos_id = i  # CPM's end-of-document control piece
+            if p.type == 2:  # UNKNOWN
+                unk_id, unk_piece = i, p.piece
+                continue
+            if p.type != 1:  # CONTROL/USER_DEFINED/BYTE keep ids, no score
+                continue
+            pieces[p.piece] = p.score
+        escape = True
+        if proto.HasField("normalizer_spec") and proto.normalizer_spec.HasField(
+                "escape_whitespaces"):
+            escape = proto.normalizer_spec.escape_whitespaces
+        sp = cls(pieces, ids, unk_id, unk_piece, escape)
+        sp.eos_id = eos_id
+        return sp
+
+    def encode(self, text: str) -> List[int]:
+        if self.escape_whitespaces:
+            text = text.replace(" ", _WORD_SEP)
+        n = len(text)
+        if not n:
+            return []
+        neg = float("-inf")
+        best = [neg] * (n + 1)
+        best[0] = 0.0
+        back: List[Optional[tuple]] = [None] * (n + 1)
+        for i in range(n):
+            if best[i] == neg:
+                continue
+            top = min(self.max_piece_len, n - i)
+            for length in range(1, top + 1):
+                sub = text[i:i + length]
+                sc = self.scores.get(sub)
+                if sc is not None and best[i] + sc > best[i + length]:
+                    best[i + length] = best[i] + sc
+                    back[i + length] = (i, self.ids[sub])
+            if best[i] + self.unk_score > best[i + 1]:
+                best[i + 1] = best[i] + self.unk_score
+                back[i + 1] = (i, self.unk_id)
+        out: List[int] = []
+        pos = n
+        while pos > 0:
+            prev, piece_id = back[pos]
+            out.append(piece_id)
+            pos = prev
+        out.reverse()
+        return out
+
+    def decode(self, ids) -> str:
+        return "".join(
+            self.id_to_piece.get(int(i), self.unk_piece) for i in ids
+        )
+
+
+class GPTChineseTokenizer:
+    """CPM conventions on top of the unigram core (same interface as
+    GPTTokenizer: from_pretrained/encode/decode/vocab_size/__call__)."""
+
+    def __init__(self, model_file: str):
+        self.sp = SentencePieceUnigram.from_file(model_file)
+        try:  # reference parity (jieba ships in-image); fallback documented
+            import jieba
+
+            self._cut = lambda text: jieba.cut(text, cut_all=False)
+        except ImportError:
+            self._cut = lambda text: [text]
+
+    @classmethod
+    def from_pretrained(cls, path: str) -> "GPTChineseTokenizer":
+        import os
+
+        if os.path.isdir(path):
+            path = os.path.join(path, "sentencepiece.model")
+        return cls(path)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.sp.ids)
+
+    @property
+    def eos_token_id(self) -> int:
+        """End-of-document id (CPM '</s>'/'<eod>'), used by --append-eos."""
+        eos = self.sp.eos_id
+        if eos is None:
+            raise ValueError(
+                "this sentencepiece model defines no </s>/<eod> piece; "
+                "re-run without --append-eos or add the control piece")
+        return eos
+
+    def encode(self, text: str) -> List[int]:
+        words = [w.replace(" ", _SPACE).replace("\n", _NEWLINE)
+                 for w in self._cut(text)]
+        return self.sp.encode(" ".join(words))
+
+    def decode(self, ids) -> str:
+        text = self.sp.decode(ids)
+        return (text.replace(" ", "").replace(_WORD_SEP, "")
+                .replace(_SPACE, " ").replace(_NEWLINE, "\n"))
+
+    def __call__(self, text: str) -> Dict[str, List[int]]:
+        return {"input_ids": self.encode(text)}

@@ -11,6 +11,10 @@ from fleetx_tpu.models.protein.folding import (  # noqa: F401
     DistEmbeddingsAndEvoformer,
     FoldingConfig,
 )
+from fleetx_tpu.models.protein.rigid import (  # noqa: F401
+    QuatAffine,
+    Rigid,
+)
 from fleetx_tpu.models.protein.template import (  # noqa: F401
     TemplateConfig,
     TemplateEmbedding,

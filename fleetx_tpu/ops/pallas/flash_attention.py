@@ -149,6 +149,13 @@ def dropout_keep_scale(seed, bh, q_pos, k_pos, rate: float):
     return keep.astype(jnp.float32) / (1.0 - rate)
 
 
+def _mm_dtype(dtype):
+    """MXU operand dtype: bf16 operands run the MXU at full rate (f32
+    accumulation comes from preferred_element_type); any other input dtype
+    computes in f32 so the f32 parity tests stay tight."""
+    return jnp.bfloat16 if dtype == jnp.bfloat16 else jnp.float32
+
+
 def _score_mask(q_pos, k_pos, kvlen, causal: bool):
     """Bool mask for a score tile: causal triangle ∧ key inside kv_lens."""
     mask = k_pos < kvlen
@@ -206,18 +213,22 @@ def _fwd_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(jm <= last_jm)
     def _step():
-        q = q_ref[:].astype(jnp.float32) * scale
+        # bf16 inputs stay bf16 INTO the MXU (f32 accumulation via
+        # preferred_element_type) — f32 operands would run the MXU at
+        # quarter rate; f32 inputs keep the full-precision path (tests)
+        mm_dt = _mm_dtype(q_ref.dtype)
+        q = q_ref[:].astype(mm_dt)
         kvlen = kvlens_ref[bh]
         q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
 
         def body(t, carry):
             m, l, acc = carry
-            k_blk = k_ref[pl.ds(t * block_k, block_k), :].astype(jnp.float32)
-            v_blk = v_ref[pl.ds(t * block_k, block_k), :].astype(jnp.float32)
+            k_blk = k_ref[pl.ds(t * block_k, block_k), :].astype(mm_dt)
+            v_blk = v_ref[pl.ds(t * block_k, block_k), :].astype(mm_dt)
             s = jax.lax.dot_general(
                 q, k_blk, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            )  # [bq, block_k]
+            ) * scale  # [bq, block_k]; scale post-dot keeps it f32
             k_pos = (jm * major + t * block_k
                      + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
             s = jnp.where(_score_mask(q_pos, k_pos, kvlen, causal), s, NEG_INF)
@@ -235,7 +246,7 @@ def _fwd_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 p = p * dropout_keep_scale(seed_ref[0], bh, q_pos, k_pos,
                                            dropout_rate)
             acc_new = alpha * acc + jax.lax.dot_general(
-                p, v_blk, (((1,), (0,)), ((), ())),
+                p.astype(mm_dt), v_blk, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             return m_new, l_new, acc_new
@@ -278,20 +289,21 @@ def _bwd_dq_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(jm <= last_jm)
     def _step():
-        q = q_ref[:].astype(jnp.float32) * scale
-        do = do_ref[:].astype(jnp.float32)
+        mm_dt = _mm_dtype(q_ref.dtype)
+        q = q_ref[:].astype(mm_dt)
+        do = do_ref[:].astype(mm_dt)
         lse = lse_ref[:]      # [bq, 1]
         delta = delta_ref[:]  # [bq, 1]
         kvlen = kvlens_ref[bh]
         q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
 
         def body(t, dq):
-            k_blk = k_ref[pl.ds(t * block_k, block_k), :].astype(jnp.float32)
-            v_blk = v_ref[pl.ds(t * block_k, block_k), :].astype(jnp.float32)
+            k_blk = k_ref[pl.ds(t * block_k, block_k), :].astype(mm_dt)
+            v_blk = v_ref[pl.ds(t * block_k, block_k), :].astype(mm_dt)
             s = jax.lax.dot_general(
                 q, k_blk, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            )
+            ) * scale
             k_pos = (jm * major + t * block_k
                      + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
             mask = _score_mask(q_pos, k_pos, kvlen, causal)
@@ -308,7 +320,7 @@ def _bwd_dq_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                                              dropout_rate)
             ds = p * (dp - delta)
             return dq + jax.lax.dot_general(
-                ds, k_blk, (((1,), (0,)), ((), ())),
+                ds.astype(mm_dt), k_blk, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
 
@@ -359,21 +371,22 @@ def _bwd_dkv_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(im >= first_im)
     def _step():
-        k = k_ref[:].astype(jnp.float32)
-        v = v_ref[:].astype(jnp.float32)
+        mm_dt = _mm_dtype(k_ref.dtype)
+        k = k_ref[:].astype(mm_dt)
+        v = v_ref[:].astype(mm_dt)
         kvlen = kvlens_ref[bh]
         k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
 
         def body(t, carry):
             dk, dv = carry
-            q_blk = q_ref[pl.ds(t * block_q, block_q), :].astype(jnp.float32) * scale
-            do_blk = do_ref[pl.ds(t * block_q, block_q), :].astype(jnp.float32)
+            q_blk = q_ref[pl.ds(t * block_q, block_q), :].astype(mm_dt)
+            do_blk = do_ref[pl.ds(t * block_q, block_q), :].astype(mm_dt)
             lse = lse_ref[pl.ds(t * block_q, block_q), :]      # [block_q, 1]
             delta = delta_ref[pl.ds(t * block_q, block_q), :]  # [block_q, 1]
             s = jax.lax.dot_general(
                 q_blk, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            )
+            ) * scale
             q_pos = (im * major + t * block_q
                      + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0))
             mask = _score_mask(q_pos, k_pos, kvlen, causal)
@@ -391,14 +404,12 @@ def _bwd_dkv_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             else:
                 p_v = p
             dv_new = dv + jax.lax.dot_general(
-                p_v, do_blk, (((0,), (0,)), ((), ())),
+                p_v.astype(mm_dt), do_blk, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             ds = p * (dp - delta)
-            # q tiles were loaded pre-scaled, so the chain rule's `scale`
-            # factor is already inside `ds @ q_scaled`
             dk_new = dk + jax.lax.dot_general(
-                ds, q_blk, (((0,), (0,)), ((), ())),
+                ds.astype(mm_dt), q_blk, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             return dk_new, dv_new
@@ -414,7 +425,9 @@ def _bwd_dkv_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(im == n_major - 1)
     def _finalize():
-        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        # q was loaded UNSCALED (bf16 MXU path), so the chain rule's scale
+        # factor lands here: dL/dk = scale * ds^T @ q
+        dk_ref[:] = (dk_scr[:] * scale).astype(dk_ref.dtype)
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 

@@ -325,3 +325,24 @@ def test_block_env_override_validation():
         )
         assert proc.returncode != 0, bad
         assert "FLEETX_FLASH_BLOCK_Q" in proc.stderr, proc.stderr[-500:]
+
+
+def test_bf16_grads_match_reference():
+    """bf16 operands now feed the MXU directly in all three kernels (f32
+    accumulation); grads must still track the XLA reference at bf16-level
+    tolerance."""
+    q, k, v = _qkv(s=256, d=32, dtype=jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v).astype(jnp.float32) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_ref(q, k, v).astype(jnp.float32) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=1e-1, atol=1e-1, err_msg=f"d{name} mismatch",
+        )

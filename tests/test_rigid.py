@@ -1,0 +1,150 @@
+"""Rigid/QuatAffine algebra property tests (reference r3.py + quat_affine.py
+surface; VERDICT r3 missing #3 — the op breadth a structure module needs)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fleetx_tpu.models.protein import rigid as R
+
+
+def _random_rigid(rng, shape=(5,)):
+    # rotation via Gram-Schmidt of random vectors => uniform-ish, orthonormal
+    e0 = jnp.asarray(rng.randn(*shape, 3), jnp.float32)
+    e1 = jnp.asarray(rng.randn(*shape, 3), jnp.float32)
+    rot = R.rots_from_two_vecs(e0, e1)
+    trans = jnp.asarray(rng.randn(*shape, 3), jnp.float32)
+    return R.Rigid(rot, trans)
+
+
+def test_compose_invert_roundtrip():
+    rng = np.random.RandomState(0)
+    a, b = _random_rigid(rng), _random_rigid(rng)
+    p = jnp.asarray(rng.randn(5, 3), jnp.float32)
+    # (a ∘ b)(p) == a(b(p))
+    np.testing.assert_allclose(
+        np.asarray(R.apply_rigid(R.compose_rigids(a, b), p)),
+        np.asarray(R.apply_rigid(a, R.apply_rigid(b, p))), atol=1e-5)
+    # a^-1 ∘ a == identity on points
+    np.testing.assert_allclose(
+        np.asarray(R.apply_rigid(R.invert_rigid(a), R.apply_rigid(a, p))),
+        np.asarray(p), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(R.apply_inverse_rigid(a, R.apply_rigid(a, p))),
+        np.asarray(p), atol=1e-5)
+
+
+def test_rots_from_two_vecs_orthonormal():
+    rng = np.random.RandomState(1)
+    rot = _random_rigid(rng).rot
+    eye = jnp.swapaxes(rot, -1, -2) @ rot
+    np.testing.assert_allclose(np.asarray(eye),
+                               np.broadcast_to(np.eye(3), eye.shape),
+                               atol=1e-5)
+    det = np.linalg.det(np.asarray(rot))
+    np.testing.assert_allclose(det, 1.0, atol=1e-5)
+
+
+def test_flat9_flat12_tensor4x4_roundtrips():
+    rng = np.random.RandomState(2)
+    r = _random_rigid(rng)
+    r9 = R.rigid_from_tensor_flat9(R.rigid_to_tensor_flat9(r))
+    np.testing.assert_allclose(np.asarray(r9.rot), np.asarray(r.rot), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(r9.trans), np.asarray(r.trans), atol=1e-5)
+    r12 = R.rigid_from_tensor_flat12(R.rigid_to_tensor_flat12(r))
+    np.testing.assert_allclose(np.asarray(r12.rot), np.asarray(r.rot), atol=1e-6)
+    m = jnp.zeros((5, 4, 4)).at[..., :3, :3].set(r.rot).at[..., :3, 3].set(
+        r.trans).at[..., 3, 3].set(1.0)
+    r44 = R.rigid_from_tensor4x4(m)
+    np.testing.assert_allclose(np.asarray(r44.rot), np.asarray(r.rot), atol=1e-6)
+
+
+def test_rigid_is_a_pytree():
+    rng = np.random.RandomState(3)
+    r = _random_rigid(rng)
+    doubled = jax.tree.map(lambda x: 2 * x, r)
+    assert isinstance(doubled, R.Rigid)
+    # vmaps like any array container
+    out = jax.vmap(lambda rr, p: R.apply_rigid(rr, p))(
+        r, jnp.zeros((5, 3)))
+    assert out.shape == (5, 3)
+
+
+def test_quat_multiply_matches_rotation_composition():
+    rng = np.random.RandomState(4)
+    a, b = _random_rigid(rng), _random_rigid(rng)
+    from fleetx_tpu.models.protein.geometry import quat_to_rot, rot_to_quat
+
+    qa, qb = rot_to_quat(a.rot), rot_to_quat(b.rot)
+    rot_from_quat = quat_to_rot(R.quat_multiply(qa, qb))
+    np.testing.assert_allclose(np.asarray(rot_from_quat),
+                               np.asarray(a.rot @ b.rot), atol=1e-5)
+
+
+def test_quat_affine_pre_compose_and_points():
+    rng = np.random.RandomState(5)
+    r = _random_rigid(rng)
+    qa = R.QuatAffine.from_rigid(r)
+    p = jnp.asarray(rng.randn(5, 3), jnp.float32)
+    np.testing.assert_allclose(np.asarray(qa.apply_to_point(p)),
+                               np.asarray(R.apply_rigid(r, p)), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(qa.invert_point(qa.apply_to_point(p))), np.asarray(p),
+        atol=1e-5)
+    # zero update is the identity pre-compose
+    same = qa.pre_compose(jnp.zeros((5, 6)))
+    np.testing.assert_allclose(np.asarray(same.apply_to_point(p)),
+                               np.asarray(qa.apply_to_point(p)), atol=1e-5)
+    # translation-only update moves points by rot @ dt
+    dt = jnp.asarray(rng.randn(5, 3), jnp.float32)
+    upd = qa.pre_compose(jnp.concatenate([jnp.zeros((5, 3)), dt], -1))
+    np.testing.assert_allclose(
+        np.asarray(upd.apply_to_point(p)),
+        np.asarray(qa.apply_to_point(p)
+                   + jnp.einsum("...ij,...j->...i", r.rot, dt)), atol=1e-5)
+    # extra_dims broadcasts N points per transform
+    pts = jnp.asarray(rng.randn(5, 7, 3), jnp.float32)
+    out = qa.apply_to_point(pts, extra_dims=1)
+    assert out.shape == (5, 7, 3)
+
+
+def test_quat_affine_invert_and_tensor_roundtrip():
+    rng = np.random.RandomState(6)
+    r = _random_rigid(rng)
+    qa = R.QuatAffine.from_rigid(r)
+    p = jnp.asarray(rng.randn(5, 3), jnp.float32)
+    inv = qa.invert()  # the reference leaves QuatAffine.invert as TODO
+    np.testing.assert_allclose(
+        np.asarray(inv.apply_to_point(qa.apply_to_point(p))), np.asarray(p),
+        atol=1e-5)
+    back = R.QuatAffine.from_tensor(qa.to_tensor())
+    np.testing.assert_allclose(np.asarray(back.rotation),
+                               np.asarray(qa.rotation), atol=1e-5)
+    scaled = qa.scale_translation(2.0)
+    np.testing.assert_allclose(np.asarray(scaled.translation),
+                               2 * np.asarray(qa.translation), atol=1e-6)
+    # stop_rot_gradient detaches the rotation path: grads wrt the input
+    # quaternion vanish (translation here does not depend on it)
+    def loss(q):
+        stopped = R.QuatAffine(q, qa.translation).stop_rot_gradient()
+        return (stopped.apply_to_point(p) ** 2).sum()
+
+    g = jax.grad(loss)(qa.quaternion)
+    np.testing.assert_allclose(np.asarray(g), 0.0, atol=1e-6)
+
+
+def test_make_canonical_transform_places_backbone():
+    rng = np.random.RandomState(7)
+    n = jnp.asarray(rng.randn(4, 3), jnp.float32)
+    ca = jnp.asarray(rng.randn(4, 3), jnp.float32)
+    c = jnp.asarray(rng.randn(4, 3), jnp.float32)
+    rot, trans = R.make_canonical_transform(n, ca, c)
+    move = lambda p: jnp.einsum("...ij,...j->...i", rot, p) + trans
+    np.testing.assert_allclose(np.asarray(move(ca)), 0.0, atol=1e-5)
+    c_moved = np.asarray(move(c))
+    np.testing.assert_allclose(c_moved[..., 1:], 0.0, atol=1e-4)  # on x-axis
+    assert (c_moved[..., 0] > 0).all()
+    n_moved = np.asarray(move(n))
+    np.testing.assert_allclose(n_moved[..., 2], 0.0, atol=1e-4)  # xy plane

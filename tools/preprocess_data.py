@@ -35,7 +35,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 
 from fleetx_tpu.utils.log import logger
 
-TOKENIZERS = ("GPTTokenizer", "ErnieTokenizer")
+TOKENIZERS = ("GPTTokenizer", "ErnieTokenizer", "GPTChineseTokenizer")
 
 _worker = {}
 
@@ -49,6 +49,12 @@ def _make_tokenizer(name, vocab_dir):
         from fleetx_tpu.data.tokenizers.ernie_tokenizer import ErnieTokenizer
 
         return ErnieTokenizer.from_pretrained(vocab_dir)
+    if name == "GPTChineseTokenizer":  # CPM unigram; user-supplied .model
+        from fleetx_tpu.data.tokenizers.gpt_cn_tokenizer import (
+            GPTChineseTokenizer,
+        )
+
+        return GPTChineseTokenizer.from_pretrained(vocab_dir)
     raise ValueError(f"unknown tokenizer {name!r}; choose from {TOKENIZERS}")
 
 
