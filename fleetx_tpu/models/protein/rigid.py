@@ -57,6 +57,7 @@ class Rigid(NamedTuple):
 
 
 def identity_rigid(shape=(), dtype=jnp.float32) -> Rigid:
+    """Identity transform batched to ``shape`` (reference identity_rigids)."""
     rot = jnp.broadcast_to(jnp.eye(3, dtype=dtype), (*shape, 3, 3))
     return Rigid(rot, jnp.zeros((*shape, 3), dtype))
 
@@ -68,6 +69,8 @@ def compose_rigids(a: Rigid, b: Rigid) -> Rigid:
 
 
 def invert_rigid(r: Rigid) -> Rigid:
+    """g^-1: transpose rotation, counter-rotate the negated translation
+    (reference invert_rigids)."""
     inv_rot = jnp.swapaxes(r.rot, -1, -2)
     return Rigid(inv_rot, -jnp.einsum("...ij,...j->...i", inv_rot, r.trans))
 
@@ -78,6 +81,8 @@ def apply_rigid(r: Rigid, point: jax.Array) -> jax.Array:
 
 
 def apply_inverse_rigid(r: Rigid, point: jax.Array) -> jax.Array:
+    """global -> local without materializing the inverse (reference
+    rigids_mul_vecs(invert_rigids(r), x))."""
     return jnp.einsum("...ji,...j->...i", r.rot, point - r.trans)
 
 
@@ -87,6 +92,8 @@ def robust_norm(v: jax.Array, epsilon: float = 1e-8) -> jax.Array:
 
 
 def robust_normalize(v: jax.Array, epsilon: float = 1e-8) -> jax.Array:
+    """Unit vector with the same guarded norm (reference
+    vecs_robust_normalize)."""
     return v / robust_norm(v, epsilon)[..., None]
 
 
@@ -116,6 +123,8 @@ def rigid_to_tensor_flat9(r: Rigid) -> jax.Array:
 
 
 def rigid_from_tensor_flat9(m: jax.Array) -> Rigid:
+    """[..., 9] -> Rigid: Gram-Schmidt the two stored columns back into a
+    rotation (reference rigids_from_tensor_flat9)."""
     e0, e1, trans = m[..., 0:3], m[..., 3:6], m[..., 6:9]
     return Rigid(rots_from_two_vecs(e0, e1), trans)
 
@@ -127,6 +136,7 @@ def rigid_to_tensor_flat12(r: Rigid) -> jax.Array:
 
 
 def rigid_from_tensor_flat12(m: jax.Array) -> Rigid:
+    """[..., 12] -> Rigid (reference rigids_from_tensor_flat12)."""
     return Rigid(m[..., :9].reshape(*m.shape[:-1], 3, 3), m[..., 9:12])
 
 

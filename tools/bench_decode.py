@@ -91,12 +91,15 @@ def decode_records(modes=("greedy", "beam"), batches=(1, 8), steps: int = 3):
         for b in batches:
             ids = prompt1[:b]
             out = run(variables, ids)  # compile + warmup
-            jax.block_until_ready(out)
+            # host transfer = hard sync: block_until_ready does NOT wait on
+            # the tunneled axon platform (it reported 17M tok/s), so every
+            # timing ends with a device_get, exactly like bench.py's trainer
+            jax.device_get(out)
             times = []
             for _ in range(steps):
                 t0 = time.perf_counter()
                 out = run(variables, ids)
-                jax.block_until_ready(out)
+                jax.device_get(out)
                 times.append(time.perf_counter() - t0)
             dt = float(np.median(times))
             toks = b * GEN_LEN

@@ -21,12 +21,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 SWEEP = [
     # (batch, recompute, granularity, block_q, block_k)
+    # no-remat at 345M OOMs v5e 16GiB (benchmarks/preflight_r04.json), so
+    # the sweep stays on selective remat and walks batch x flash blocks.
     (8, "1", "core_attn", 128, 128),
-    (8, "0", "core_attn", 128, 128),
+    (8, "1", "core_attn", 256, 128),
+    (8, "1", "core_attn", 256, 256),
     (16, "1", "core_attn", 128, 128),
     (16, "1", "core_attn", 256, 128),
-    (32, "1", "core_attn", 128, 128),
-    (16, "1", "full_attn", 128, 128),
+    (16, "1", "core_attn", 512, 128),
+    (32, "1", "core_attn", 256, 128),
+    (16, "1", "full_attn", 256, 128),
 ]
 
 
@@ -56,6 +60,9 @@ def main():
             "BENCH_BATCH": str(batch), "BENCH_RECOMPUTE": rec,
             "BENCH_GRANULARITY": gran, "BENCH_STEPS": args.steps,
             "FLEETX_FLASH_BLOCK_Q": str(bq), "FLEETX_FLASH_BLOCK_K": str(bk),
+            # sweep wants the anchor train record only — no decode bench,
+            # no second-batch record (they triple the per-point wall time)
+            "BENCH_EXTRA": "0",
         }
         tag = f"b{batch} rec={rec}:{gran} blk={bq}x{bk}"
         try:
