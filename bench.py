@@ -98,10 +98,14 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
             num_attention_heads=16,
             ffn_hidden_size=4096,
             max_position_embeddings=seq,
-            hidden_dropout_prob=0.1,
-            attention_probs_dropout_prob=0.1,
+            # overridable for perf triage (e.g. quantifying the in-kernel
+            # attention-dropout cost); the anchor keeps the reference's 0.1
+            hidden_dropout_prob=float(
+                os.environ.get("BENCH_HIDDEN_DROPOUT", 0.1)),
+            attention_probs_dropout_prob=float(
+                os.environ.get("BENCH_ATTN_DROPOUT", 0.1)),
             fuse_attn_qkv=True,
-            use_flash_attention=True,
+            use_flash_attention=os.environ.get("BENCH_FLASH", "1") == "1",
             use_recompute=recompute,
             recompute_granularity=granularity,
         ),

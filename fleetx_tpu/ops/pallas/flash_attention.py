@@ -87,9 +87,13 @@ def _env_block(name: str, default: int) -> int:
 
 
 # overridable without code changes so block sizes can be swept per TPU
-# generation (bench harness: FLEETX_FLASH_BLOCK_Q=256 python bench.py)
-DEFAULT_BLOCK_Q = _env_block("FLEETX_FLASH_BLOCK_Q", 128)
-DEFAULT_BLOCK_K = _env_block("FLEETX_FLASH_BLOCK_K", 128)
+# generation (bench harness: FLEETX_FLASH_BLOCK_Q=256 python bench.py).
+# 512x512 default from the round-4 v5e sweep: at 345M/seq1024/b8 it measured
+# 23.8k tok/s vs 18.1k at 128x128 (the per-cell VPU work of online softmax
+# amortizes over bigger tiles, and fewer grid steps means less fixed
+# overhead); 1024x512 regressed (megacore q-block parallelism lost).
+DEFAULT_BLOCK_Q = _env_block("FLEETX_FLASH_BLOCK_Q", 512)
+DEFAULT_BLOCK_K = _env_block("FLEETX_FLASH_BLOCK_K", 512)
 # rows of the streamed operand resident in VMEM per grid step (the unit of
 # HBM->VMEM DMA); compute tiles walk inside it
 DEFAULT_BLOCK_MAJOR = _env_block("FLEETX_FLASH_BLOCK_MAJOR", 1024)
