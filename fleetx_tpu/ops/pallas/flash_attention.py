@@ -139,9 +139,13 @@ def dropout_keep_scale(seed, bh, q_pos, k_pos, rate: float):
     seed: int32 scalar; bh: int32 scalar batch*head index; q_pos/k_pos: int32
     grids of global positions (any broadcast-compatible shapes). Pure int32
     jnp ops so forward/backward kernels (and test references) can regenerate
-    the exact mask.
+    the exact mask. Grouped so that when callers pass a [bq, 1] q column and
+    a [1, bk] k row, the multiplies stay on the vectors (int32 multiply is
+    multi-op on the VPU) and only the combine + mix rounds touch the full
+    [bq, bk] tile; int32 + is modular, so the grouping does not change the
+    hash value vs the original flat expression.
     """
-    x = q_pos * _C1 + k_pos * _C2 + bh * _C3 + seed
+    x = (q_pos * _C1 + (bh * _C3 + seed)) + k_pos * _C2
     x = x ^ _shr(x, 16)
     x = x * _MIX1
     x = x ^ _shr(x, 15)
@@ -223,9 +227,11 @@ def _fwd_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         mm_dt = _mm_dtype(q_ref.dtype)
         q = q_ref[:].astype(mm_dt)
         kvlen = kvlens_ref[bh]
-        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+        # positions as a [bq, 1] column / [1, bk] row: masking and the
+        # dropout hash broadcast them, keeping per-cell VPU work minimal
+        q_col = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
 
-        def body(t, carry):
+        def body(t, carry, masked: bool):
             m, l, acc = carry
             k_blk = k_ref[pl.ds(t * block_k, block_k), :].astype(mm_dt)
             v_blk = v_ref[pl.ds(t * block_k, block_k), :].astype(mm_dt)
@@ -233,21 +239,24 @@ def _fwd_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 q, k_blk, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale  # [bq, block_k]; scale post-dot keeps it f32
-            k_pos = (jm * major + t * block_k
-                     + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
-            s = jnp.where(_score_mask(q_pos, k_pos, kvlen, causal), s, NEG_INF)
+            k_row = (jm * major + t * block_k
+                     + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
+            if masked:
+                s = jnp.where(_score_mask(q_col, k_row, kvlen, causal),
+                              s, NEG_INF)
 
             m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
             p = jnp.exp(s - m_new)
-            # fully-masked rows: keep p exactly 0 (avoids exp(NEG-NEG)=1
-            # garbage rows feeding dV through p in the backward kernels)
-            p = jnp.where(s > NEG_INF / 2, p, 0.0)
+            if masked:
+                # fully-masked rows: keep p exactly 0 (avoids exp(NEG-NEG)=1
+                # garbage rows feeding dV through p in the backward kernels)
+                p = jnp.where(s > NEG_INF / 2, p, 0.0)
             alpha = jnp.exp(m - m_new)
             # The softmax normalizer sums the *undropped* probabilities;
             # dropout scales only the value path (out = drop(softmax(s)) @ v).
             l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
             if dropout_rate > 0.0:
-                p = p * dropout_keep_scale(seed_ref[0], bh, q_pos, k_pos,
+                p = p * dropout_keep_scale(seed_ref[0], bh, q_col, k_row,
                                            dropout_rate)
             acc_new = alpha * acc + jax.lax.dot_general(
                 p.astype(mm_dt), v_blk, (((1,), (0,)), ((), ())),
@@ -255,14 +264,30 @@ def _fwd_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             )
             return m_new, l_new, acc_new
 
+        # two-phase walk: tiles strictly inside the causal triangle AND
+        # fully below kv_lens skip all mask work (the bulk of the VPU cost);
+        # only diagonal-crossing / kv-cut tiles run the masked body
+        n_kv_full = jnp.clip((kvlen - jm * major) // block_k, 0, tiles)
+        n_kv_any = jnp.clip(
+            (kvlen - jm * major + block_k - 1) // block_k, 0, tiles
+        )
         if causal:
-            # exact tile count at/before the diagonal inside this major block
-            n_inner = jnp.clip(((i + 1) * bq - jm * major) // block_k,
-                               0, tiles)
+            n_causal = jnp.clip(((i + 1) * bq - jm * major) // block_k,
+                                0, tiles)
+            n_causal_free = jnp.clip((i * bq - jm * major + 1) // block_k,
+                                     0, tiles)
+            n_inner = jnp.minimum(n_causal, n_kv_any)
+            n_free = jnp.minimum(n_causal_free, n_kv_full)
         else:
-            n_inner = tiles
+            n_inner = n_kv_any
+            n_free = n_kv_full
+        n_free = jnp.minimum(n_free, n_inner)
+        carry = (m_scr[:], l_scr[:], acc_scr[:])
+        carry = jax.lax.fori_loop(
+            0, n_free, functools.partial(body, masked=False), carry
+        )
         m, l, acc = jax.lax.fori_loop(
-            0, n_inner, body, (m_scr[:], l_scr[:], acc_scr[:])
+            n_free, n_inner, functools.partial(body, masked=True), carry
         )
         m_scr[:] = m
         l_scr[:] = l
@@ -299,20 +324,22 @@ def _bwd_dq_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         lse = lse_ref[:]      # [bq, 1]
         delta = delta_ref[:]  # [bq, 1]
         kvlen = kvlens_ref[bh]
-        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+        q_col = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
 
-        def body(t, dq):
+        def body(t, dq, masked: bool):
             k_blk = k_ref[pl.ds(t * block_k, block_k), :].astype(mm_dt)
             v_blk = v_ref[pl.ds(t * block_k, block_k), :].astype(mm_dt)
             s = jax.lax.dot_general(
                 q, k_blk, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale
-            k_pos = (jm * major + t * block_k
-                     + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
-            mask = _score_mask(q_pos, k_pos, kvlen, causal)
-            s = jnp.where(mask, s, NEG_INF)
-            p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+            k_row = (jm * major + t * block_k
+                     + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
+            if masked:
+                mask = _score_mask(q_col, k_row, kvlen, causal)
+                p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+            else:
+                p = jnp.exp(s - lse)
             dp = jax.lax.dot_general(
                 do, v_blk, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -320,7 +347,7 @@ def _bwd_dq_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             if dropout_rate > 0.0:
                 # dP = (dO @ V^T) ∘ mask; delta already equals rowsum(P ∘ dP)
                 # because delta = rowsum(dO ∘ O) and O = (P ∘ mask) @ V.
-                dp = dp * dropout_keep_scale(seed_ref[0], bh, q_pos, k_pos,
+                dp = dp * dropout_keep_scale(seed_ref[0], bh, q_col, k_row,
                                              dropout_rate)
             ds = p * (dp - delta)
             return dq + jax.lax.dot_general(
@@ -328,12 +355,27 @@ def _bwd_dq_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                 preferred_element_type=jnp.float32,
             )
 
+        n_kv_full = jnp.clip((kvlen - jm * major) // block_k, 0, tiles)
+        n_kv_any = jnp.clip(
+            (kvlen - jm * major + block_k - 1) // block_k, 0, tiles
+        )
         if causal:
-            n_inner = jnp.clip(((i + 1) * bq - jm * major) // block_k,
-                               0, tiles)
+            n_causal = jnp.clip(((i + 1) * bq - jm * major) // block_k,
+                                0, tiles)
+            n_causal_free = jnp.clip((i * bq - jm * major + 1) // block_k,
+                                     0, tiles)
+            n_inner = jnp.minimum(n_causal, n_kv_any)
+            n_free = jnp.minimum(n_causal_free, n_kv_full)
         else:
-            n_inner = tiles
-        dq_scr[:] = jax.lax.fori_loop(0, n_inner, body, dq_scr[:])
+            n_inner = n_kv_any
+            n_free = n_kv_full
+        n_free = jnp.minimum(n_free, n_inner)
+        dq = jax.lax.fori_loop(
+            0, n_free, functools.partial(body, masked=False), dq_scr[:]
+        )
+        dq_scr[:] = jax.lax.fori_loop(
+            n_free, n_inner, functools.partial(body, masked=True), dq
+        )
 
     @pl.when(jm == last_jm)
     def _finalize():
@@ -379,9 +421,9 @@ def _bwd_dkv_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         k = k_ref[:].astype(mm_dt)
         v = v_ref[:].astype(mm_dt)
         kvlen = kvlens_ref[bh]
-        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+        k_row = j * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
 
-        def body(t, carry):
+        def body(t, carry, masked: bool):
             dk, dv = carry
             q_blk = q_ref[pl.ds(t * block_q, block_q), :].astype(mm_dt)
             do_blk = do_ref[pl.ds(t * block_q, block_q), :].astype(mm_dt)
@@ -391,17 +433,19 @@ def _bwd_dkv_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                 q_blk, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale
-            q_pos = (im * major + t * block_q
-                     + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0))
-            mask = _score_mask(q_pos, k_pos, kvlen, causal)
-            s = jnp.where(mask, s, NEG_INF)
-            p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+            q_col = (im * major + t * block_q
+                     + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0))
+            if masked:
+                mask = _score_mask(q_col, k_row, kvlen, causal)
+                p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+            else:
+                p = jnp.exp(s - lse)
             dp = jax.lax.dot_general(
                 do_blk, v, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             if dropout_rate > 0.0:
-                drop = dropout_keep_scale(seed_ref[0], bh, q_pos, k_pos,
+                drop = dropout_keep_scale(seed_ref[0], bh, q_col, k_row,
                                           dropout_rate)
                 p_v = p * drop  # dropped probabilities feed dV
                 dp = dp * drop
@@ -421,9 +465,26 @@ def _bwd_dkv_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         if causal:
             # first q tile inside this major block at/after the diagonal
             t0 = jnp.clip((j * bk) // block_q - im * tiles, 0, tiles)
+            # first q tile fully past the diagonal (min q >= max k): mask-free
+            t_free_c = jnp.clip(
+                ((j + 1) * bk - 1 - im * major + block_q - 1) // block_q,
+                0, tiles,
+            )
         else:
-            t0 = 0
-        dk, dv = jax.lax.fori_loop(t0, tiles, body, (dk_scr[:], dv_scr[:]))
+            t0 = jnp.int32(0)
+            t_free_c = jnp.int32(0)
+        # a kv cut inside this k block masks EVERY q tile (column mask)
+        kv_full = (j + 1) * bk <= kvlen
+        t_free = jnp.where(kv_full, jnp.maximum(t_free_c, t0),
+                           jnp.int32(tiles))
+        carry = (dk_scr[:], dv_scr[:])
+        carry = jax.lax.fori_loop(
+            t0, jnp.minimum(t_free, tiles),
+            functools.partial(body, masked=True), carry,
+        )
+        dk, dv = jax.lax.fori_loop(
+            t_free, tiles, functools.partial(body, masked=False), carry
+        )
         dk_scr[:] = dk
         dv_scr[:] = dv
 
