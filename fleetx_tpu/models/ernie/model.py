@@ -34,6 +34,7 @@ from fleetx_tpu.models.gpt.model import (
     attn_out_dense,
 )
 from fleetx_tpu.ops.attention import causal_attention
+from fleetx_tpu.ops.dropout import HashDropout
 
 Dtype = Any
 
@@ -133,14 +134,14 @@ class ErnieEncoderLayer(nn.Module):
         cfg = self.cfg
         x = _constrain_act(x, cfg)
         y = ErnieSelfAttention(cfg, name="attn")(x, attn_mask, deterministic=deterministic)
-        y = nn.Dropout(cfg.hidden_dropout_prob, name="attn_dropout")(
+        y = HashDropout(cfg.hidden_dropout_prob, name="attn_dropout")(
             y, deterministic=deterministic
         )
         x = _layer_norm(cfg, "norm1")(x + y)
         y = _dense(cfg.ffn_size, ("embed", "mlp"), "linear1", dtype=cfg.dtype)(x)
         y = nn.gelu(y, approximate=cfg.hidden_act != "gelu")
         y = _dense(cfg.hidden_size, ("mlp", "embed"), "linear2", dtype=cfg.dtype)(y)
-        y = nn.Dropout(cfg.hidden_dropout_prob, name="ffn_dropout")(
+        y = HashDropout(cfg.hidden_dropout_prob, name="ffn_dropout")(
             y, deterministic=deterministic
         )
         x = _layer_norm(cfg, "norm2")(x + y)
@@ -206,7 +207,7 @@ class ErnieModel(nn.Module):
         )
         x = word_emb[input_ids] + pos_emb[position_ids] + type_emb[token_type_ids]
         x = _layer_norm(cfg, "embed_norm")(x.astype(cfg.dtype))
-        x = nn.Dropout(cfg.hidden_dropout_prob, name="embed_dropout")(
+        x = HashDropout(cfg.hidden_dropout_prob, name="embed_dropout")(
             x, deterministic=deterministic
         )
         x = _constrain_act(x, cfg)
@@ -310,7 +311,7 @@ class ErnieForSequenceClassification(nn.Module):
             input_ids, token_type_ids, position_ids, attention_mask,
             deterministic=deterministic,
         )
-        pooled = nn.Dropout(self.cfg.hidden_dropout_prob, name="cls_dropout")(
+        pooled = HashDropout(self.cfg.hidden_dropout_prob, name="cls_dropout")(
             pooled, deterministic=deterministic
         )
         return _dense(self.num_classes, ("embed", None), "classifier",

@@ -28,6 +28,7 @@ from fleetx_tpu.models.gpt.model import (
     default_kernel_init,
 )
 from fleetx_tpu.ops.attention import causal_attention
+from fleetx_tpu.ops.dropout import HashDropout
 
 Dtype = Any
 
@@ -135,7 +136,7 @@ class ViTBlock(nn.Module):
             use_flash=False,
         )
         y = attn_out_dense(cfg.hidden_size, cfg.dtype)(y)
-        y = nn.Dropout(cfg.drop_rate, name="proj_drop")(y, deterministic=deterministic)
+        y = HashDropout(cfg.drop_rate, name="proj_drop")(y, deterministic=deterministic)
         x = x + DropPath(self.drop_path, name="drop_path1")(y, deterministic)
 
         y = _layer_norm(cfg, "norm2")(x)
@@ -143,7 +144,7 @@ class ViTBlock(nn.Module):
                    dtype=cfg.dtype)(y)
         y = nn.gelu(y, approximate=cfg.hidden_act != "gelu")
         y = _dense(cfg.hidden_size, ("mlp", "embed"), "fc2", dtype=cfg.dtype)(y)
-        y = nn.Dropout(cfg.drop_rate, name="mlp_drop")(y, deterministic=deterministic)
+        y = HashDropout(cfg.drop_rate, name="mlp_drop")(y, deterministic=deterministic)
         x = x + DropPath(self.drop_path, name="drop_path2")(y, deterministic)
         return _constrain_act(x, cfg)
 
@@ -191,7 +192,7 @@ class ViT(nn.Module):
             jnp.float32,
         )
         x = x + pos_emb.astype(cfg.dtype)
-        x = nn.Dropout(cfg.drop_rate, name="pos_drop")(x, deterministic=deterministic)
+        x = HashDropout(cfg.drop_rate, name="pos_drop")(x, deterministic=deterministic)
         x = _constrain_act(x, cfg)
 
         # linearly-increasing stochastic depth (reference vit.py dpr rule)
