@@ -108,6 +108,9 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
             use_flash_attention=os.environ.get("BENCH_FLASH", "1") == "1",
             use_recompute=recompute,
             recompute_granularity=granularity,
+            # e.g. BENCH_EXTRA_SAVES=qkv_out,ffn_gelu : spend HBM on saved
+            # activations to cut backward recompute (docs/PERFORMANCE.md)
+            recompute_extra_saves=os.environ.get("BENCH_EXTRA_SAVES"),
         ),
         Optimizer=AttrDict(
             name="FusedAdamW",

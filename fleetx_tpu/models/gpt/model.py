@@ -55,6 +55,12 @@ class GPTConfig:
     sequence_parallel: bool = False
     use_recompute: bool = False
     recompute_granularity: Optional[str] = None  # full | full_attn | core_attn
+    # extra checkpoint_name'd tensors to SAVE on top of the granularity's
+    # base save-set: trades HBM for less backward recompute. Named sites:
+    # 'qkv_out' (skip re-running the qkv projection), 'ffn_gelu' (skip
+    # up_proj + gelu — the widest activation), 'mlp_out', 'attn_out'.
+    # v5e guidance in docs/PERFORMANCE.md.
+    recompute_extra_saves: Optional[Tuple[str, ...]] = None
     no_recompute_layers: Optional[Tuple[int, ...]] = None
     use_flash_attention: bool = True
     # hidden dropouts via the lowbias32 counter hash (ops/dropout.py) —
@@ -103,6 +109,11 @@ class GPTConfig:
         nrl = kw.get("no_recompute_layers")
         if nrl is not None:
             kw["no_recompute_layers"] = tuple(nrl)
+        res = kw.get("recompute_extra_saves")
+        if res is not None:
+            if isinstance(res, str):  # "qkv_out,ffn_gelu" CLI/-o form
+                res = [s for s in res.split(",") if s]
+            kw["recompute_extra_saves"] = tuple(res)
         if model_cfg.get("num_experts") and model_cfg["num_experts"] > 1:
             kw["expert_mode"] = True
         return cls(**kw)
@@ -157,11 +168,13 @@ class SelfAttention(nn.Module):
 
         if cfg.fuse_attn_qkv:
             qkv = _dense((nh, 3 * hd), ("embed", "heads", "kv"), "qkv_proj", dtype=cfg.dtype)(x)
+            qkv = checkpoint_name(qkv, "qkv_out")
             q, k, v = jnp.split(qkv, 3, axis=-1)
         else:
             q = _dense((nh, hd), ("embed", "heads", "kv"), "q_proj", dtype=cfg.dtype)(x)
             k = _dense((nh, hd), ("embed", "heads", "kv"), "k_proj", dtype=cfg.dtype)(x)
             v = _dense((nh, hd), ("embed", "heads", "kv"), "v_proj", dtype=cfg.dtype)(x)
+            q, k, v = (checkpoint_name(t, "qkv_out") for t in (q, k, v))
 
         causal = True
         if decode:
@@ -252,7 +265,7 @@ class MLP(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         x = _dense(cfg.ffn_size, ("embed", "mlp"), "up_proj", dtype=cfg.dtype)(x)
-        x = nn.gelu(x, approximate=True)
+        x = checkpoint_name(nn.gelu(x, approximate=True), "ffn_gelu")
         x = _dense(cfg.hidden_size, ("mlp", "embed"), "down_proj", dtype=cfg.dtype)(x)
         return checkpoint_name(x, "mlp_out")
 
@@ -332,12 +345,17 @@ def _remat_policy(cfg: GPTConfig):
     if not cfg.use_recompute:
         return None
     g = cfg.recompute_granularity or "full"
+    extra = tuple(cfg.recompute_extra_saves or ())
     if g == "full":
+        if extra:  # 'full' + saves = a graded point between full and attn
+            return jax.checkpoint_policies.save_only_these_names(*extra)
         return jax.checkpoint_policies.nothing_saveable
     if g == "full_attn":
-        return jax.checkpoint_policies.save_only_these_names("attn_out")
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out", *extra)
     if g == "core_attn":
-        return jax.checkpoint_policies.save_only_these_names("core_attn_out")
+        return jax.checkpoint_policies.save_only_these_names(
+            "core_attn_out", *extra)
     raise ValueError(f"unknown recompute_granularity {g!r}")
 
 

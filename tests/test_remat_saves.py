@@ -1,0 +1,74 @@
+"""recompute_extra_saves: graded remat save-sets (models/gpt/model.py).
+
+The granularity's base save-set plus extra checkpoint_name'd tensors must
+not change the math — only the memory/recompute tradeoff.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fleetx_tpu.models.gpt.model import (
+    GPTConfig,
+    GPTForPretraining,
+    _remat_policy,
+)
+
+
+def _loss_and_grads(cfg):
+    model = GPTForPretraining(cfg)
+    tokens = (jnp.arange(64).reshape(2, 32) * 7) % cfg.vocab_size
+    labels = jnp.roll(tokens, -1, axis=1)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+
+    def loss_fn(params):
+        logits = model.apply(params, tokens)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.mean(
+            jnp.take_along_axis(lp, labels[..., None], axis=-1)
+        )
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    return loss, grads
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab_size=128, hidden_size=64, num_layers=2, num_attention_heads=4,
+        ffn_hidden_size=128, max_position_embeddings=32,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        dtype=jnp.float32, use_recompute=True,
+        recompute_granularity="core_attn",
+    )
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def test_extra_saves_do_not_change_math():
+    l0, g0 = _loss_and_grads(_cfg())
+    l1, g1 = _loss_and_grads(_cfg(
+        recompute_extra_saves=("qkv_out", "ffn_gelu")))
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        g0, g1,
+    )
+
+
+def test_full_granularity_with_saves_is_graded():
+    pol = _remat_policy(_cfg(recompute_granularity="full",
+                             recompute_extra_saves=("ffn_gelu",)))
+    assert pol is not None
+    l0, _ = _loss_and_grads(_cfg(recompute_granularity="full"))
+    l1, _ = _loss_and_grads(_cfg(recompute_granularity="full",
+                                 recompute_extra_saves=("ffn_gelu",)))
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+
+
+def test_from_model_config_parses_csv_and_list():
+    a = GPTConfig.from_model_config(
+        {"vocab_size": 128, "recompute_extra_saves": "qkv_out,ffn_gelu"})
+    assert a.recompute_extra_saves == ("qkv_out", "ffn_gelu")
+    b = GPTConfig.from_model_config(
+        {"vocab_size": 128, "recompute_extra_saves": ["mlp_out"]})
+    assert b.recompute_extra_saves == ("mlp_out",)
