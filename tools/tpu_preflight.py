@@ -20,17 +20,19 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 SWEEP = [
-    # (batch, recompute, granularity, block_q, block_k)
+    # (batch, granularity, block_q, block_k, extra_saves)
     # no-remat at 345M OOMs v5e 16GiB (benchmarks/preflight_r04.json), so
-    # the sweep stays on selective remat and walks batch x flash blocks.
-    (8, "1", "core_attn", 128, 128),
-    (8, "1", "core_attn", 256, 128),
-    (8, "1", "core_attn", 256, 256),
-    (16, "1", "core_attn", 128, 128),
-    (16, "1", "core_attn", 256, 128),
-    (16, "1", "core_attn", 512, 128),
-    (32, "1", "core_attn", 256, 128),
-    (16, "1", "full_attn", 256, 128),
+    # the sweep stays on selective remat and walks batch x flash blocks x
+    # remat save-set (docs/PERFORMANCE.md). 512x512 b16 measured best
+    # (25.5k tok/s / 29.6% MFU) before the extra-saves knob existed.
+    (8, "core_attn", 512, 512, ""),
+    (8, "core_attn", 512, 512, "qkv_out,ffn_gelu"),
+    (8, "core_attn", 512, 512, "qkv_out,ffn_gelu,mlp_out,attn_out"),
+    (16, "core_attn", 512, 512, ""),
+    (16, "core_attn", 512, 512, "qkv_out"),
+    (16, "core_attn", 512, 512, "qkv_out,ffn_gelu"),
+    (16, "core_attn", 256, 256, ""),
+    (32, "core_attn", 512, 512, ""),
 ]
 
 
@@ -54,17 +56,18 @@ def main():
         return
     print("== bench sweep ==", flush=True)
     best = None
-    for batch, rec, gran, bq, bk in SWEEP:
+    for batch, gran, bq, bk, saves in SWEEP:
         env = {
             **os.environ,
-            "BENCH_BATCH": str(batch), "BENCH_RECOMPUTE": rec,
+            "BENCH_BATCH": str(batch), "BENCH_RECOMPUTE": "1",
             "BENCH_GRANULARITY": gran, "BENCH_STEPS": args.steps,
             "FLEETX_FLASH_BLOCK_Q": str(bq), "FLEETX_FLASH_BLOCK_K": str(bk),
+            "BENCH_EXTRA_SAVES": saves,
             # sweep wants the anchor train record only — no decode bench,
             # no second-batch record (they triple the per-point wall time)
             "BENCH_EXTRA": "0",
         }
-        tag = f"b{batch} rec={rec}:{gran} blk={bq}x{bk}"
+        tag = f"b{batch} rec={gran} blk={bq}x{bk} saves={saves or '-'}"
         try:
             p = subprocess.run(
                 [sys.executable, "bench.py"], cwd=REPO, env=env,
