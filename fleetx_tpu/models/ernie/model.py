@@ -34,7 +34,7 @@ from fleetx_tpu.models.gpt.model import (
     attn_out_dense,
 )
 from fleetx_tpu.ops.attention import causal_attention
-from fleetx_tpu.ops.dropout import HashDropout
+from fleetx_tpu.ops.dropout import dropout_layer
 
 Dtype = Any
 
@@ -69,6 +69,8 @@ class ErnieConfig:
     # when inputs are guaranteed right-padded (the shipped ERNIE datasets
     # are); the default keeps the exact positional mask semantics.
     right_padded_inputs: bool = False
+    # hash-based hidden dropout (ops/dropout.py); False restores nn.Dropout
+    fast_dropout: bool = True
     use_recompute: bool = False
     scan_layers: bool = True
     dtype: Dtype = jnp.bfloat16
@@ -134,14 +136,14 @@ class ErnieEncoderLayer(nn.Module):
         cfg = self.cfg
         x = _constrain_act(x, cfg)
         y = ErnieSelfAttention(cfg, name="attn")(x, attn_mask, deterministic=deterministic)
-        y = HashDropout(cfg.hidden_dropout_prob, name="attn_dropout")(
+        y = dropout_layer(cfg.hidden_dropout_prob, "attn_dropout", cfg.fast_dropout)(
             y, deterministic=deterministic
         )
         x = _layer_norm(cfg, "norm1")(x + y)
         y = _dense(cfg.ffn_size, ("embed", "mlp"), "linear1", dtype=cfg.dtype)(x)
         y = nn.gelu(y, approximate=cfg.hidden_act != "gelu")
         y = _dense(cfg.hidden_size, ("mlp", "embed"), "linear2", dtype=cfg.dtype)(y)
-        y = HashDropout(cfg.hidden_dropout_prob, name="ffn_dropout")(
+        y = dropout_layer(cfg.hidden_dropout_prob, "ffn_dropout", cfg.fast_dropout)(
             y, deterministic=deterministic
         )
         x = _layer_norm(cfg, "norm2")(x + y)
@@ -207,7 +209,7 @@ class ErnieModel(nn.Module):
         )
         x = word_emb[input_ids] + pos_emb[position_ids] + type_emb[token_type_ids]
         x = _layer_norm(cfg, "embed_norm")(x.astype(cfg.dtype))
-        x = HashDropout(cfg.hidden_dropout_prob, name="embed_dropout")(
+        x = dropout_layer(cfg.hidden_dropout_prob, "embed_dropout", cfg.fast_dropout)(
             x, deterministic=deterministic
         )
         x = _constrain_act(x, cfg)
@@ -311,7 +313,7 @@ class ErnieForSequenceClassification(nn.Module):
             input_ids, token_type_ids, position_ids, attention_mask,
             deterministic=deterministic,
         )
-        pooled = HashDropout(self.cfg.hidden_dropout_prob, name="cls_dropout")(
+        pooled = dropout_layer(self.cfg.hidden_dropout_prob, "cls_dropout", self.cfg.fast_dropout)(
             pooled, deterministic=deterministic
         )
         return _dense(self.num_classes, ("embed", None), "classifier",

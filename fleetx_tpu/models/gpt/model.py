@@ -273,11 +273,9 @@ class MLP(nn.Module):
 def _dropout(cfg, name):
     """Hidden-dropout layer: hash-based by default (see ops/dropout.py);
     ``fast_dropout: False`` restores flax's threefry nn.Dropout."""
-    if cfg.fast_dropout:
-        from fleetx_tpu.ops.dropout import HashDropout
+    from fleetx_tpu.ops.dropout import dropout_layer
 
-        return HashDropout(cfg.hidden_dropout_prob, name=name)
-    return nn.Dropout(cfg.hidden_dropout_prob, name=name)
+    return dropout_layer(cfg.hidden_dropout_prob, name, cfg.fast_dropout)
 
 
 def _layer_norm(cfg, name):
@@ -341,11 +339,24 @@ class _ScanLayer(nn.Module):
         return x, None
 
 
+# every checkpoint_name site in this model; a typo'd save name would
+# otherwise silently match nothing and masquerade as the base save-set
+_CHECKPOINT_NAMES = frozenset(
+    {"qkv_out", "core_attn_out", "attn_out", "ffn_gelu", "mlp_out"}
+)
+
+
 def _remat_policy(cfg: GPTConfig):
     if not cfg.use_recompute:
         return None
     g = cfg.recompute_granularity or "full"
     extra = tuple(cfg.recompute_extra_saves or ())
+    unknown = set(extra) - _CHECKPOINT_NAMES
+    if unknown:
+        raise ValueError(
+            f"recompute_extra_saves {sorted(unknown)} match no "
+            f"checkpoint_name site; known: {sorted(_CHECKPOINT_NAMES)}"
+        )
     if g == "full":
         if extra:  # 'full' + saves = a graded point between full and attn
             return jax.checkpoint_policies.save_only_these_names(*extra)

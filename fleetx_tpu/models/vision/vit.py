@@ -28,7 +28,7 @@ from fleetx_tpu.models.gpt.model import (
     default_kernel_init,
 )
 from fleetx_tpu.ops.attention import causal_attention
-from fleetx_tpu.ops.dropout import HashDropout
+from fleetx_tpu.ops.dropout import dropout_layer
 
 Dtype = Any
 
@@ -53,6 +53,8 @@ class ViTConfig:
     representation_size: Optional[int] = None
     # 'gelu_tanh' (reference default) or 'gelu' (erf; HF ViT checkpoints)
     hidden_act: str = "gelu_tanh"
+    # hash-based hidden dropout (ops/dropout.py); False restores nn.Dropout
+    fast_dropout: bool = True
     use_recompute: bool = False
     dtype: Dtype = jnp.bfloat16
 
@@ -136,7 +138,7 @@ class ViTBlock(nn.Module):
             use_flash=False,
         )
         y = attn_out_dense(cfg.hidden_size, cfg.dtype)(y)
-        y = HashDropout(cfg.drop_rate, name="proj_drop")(y, deterministic=deterministic)
+        y = dropout_layer(cfg.drop_rate, "proj_drop", cfg.fast_dropout)(y, deterministic=deterministic)
         x = x + DropPath(self.drop_path, name="drop_path1")(y, deterministic)
 
         y = _layer_norm(cfg, "norm2")(x)
@@ -144,7 +146,7 @@ class ViTBlock(nn.Module):
                    dtype=cfg.dtype)(y)
         y = nn.gelu(y, approximate=cfg.hidden_act != "gelu")
         y = _dense(cfg.hidden_size, ("mlp", "embed"), "fc2", dtype=cfg.dtype)(y)
-        y = HashDropout(cfg.drop_rate, name="mlp_drop")(y, deterministic=deterministic)
+        y = dropout_layer(cfg.drop_rate, "mlp_drop", cfg.fast_dropout)(y, deterministic=deterministic)
         x = x + DropPath(self.drop_path, name="drop_path2")(y, deterministic)
         return _constrain_act(x, cfg)
 
@@ -192,7 +194,7 @@ class ViT(nn.Module):
             jnp.float32,
         )
         x = x + pos_emb.astype(cfg.dtype)
-        x = HashDropout(cfg.drop_rate, name="pos_drop")(x, deterministic=deterministic)
+        x = dropout_layer(cfg.drop_rate, "pos_drop", cfg.fast_dropout)(x, deterministic=deterministic)
         x = _constrain_act(x, cfg)
 
         # linearly-increasing stochastic depth (reference vit.py dpr rule)

@@ -86,16 +86,16 @@ def causal_attention(
     effective_dropout = 0.0 if deterministic else dropout_rate
 
     def _tileable(s: int) -> bool:
-        # mirror flash_attention's block clamping: env-tuned block sizes
-        # (FLEETX_FLASH_BLOCK_Q/K) must divide the sequence or we fall back
-        # to XLA instead of raising inside the kernel wrapper
+        # mirror flash_attention's block fitting: blocks shrink to the
+        # largest divisor of the sequence, so only sequences with no 8-row
+        # tile at all (s % 8 != 0 or s < 8) fall back to XLA
         from fleetx_tpu.ops.pallas.flash_attention import (
             DEFAULT_BLOCK_K,
             DEFAULT_BLOCK_Q,
+            fit_blocks,
         )
 
-        bq, bk = min(DEFAULT_BLOCK_Q, s), min(DEFAULT_BLOCK_K, s)
-        return not (s % bq or s % bk or bq % bk)
+        return fit_blocks(s, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)[0] is not None
 
     can_flash = (
         use_flash

@@ -26,7 +26,16 @@ from flax import linen as nn
 
 from fleetx_tpu.ops.pallas.flash_attention import dropout_keep_scale
 
-__all__ = ["HashDropout"]
+__all__ = ["HashDropout", "dropout_layer"]
+
+
+def dropout_layer(rate: float, name: str, fast: bool = True) -> nn.Module:
+    """The one place models pick their hidden-dropout implementation:
+    hash-based by default; ``fast=False`` (the per-family ``fast_dropout``
+    config field) restores flax's threefry ``nn.Dropout`` as a rollback."""
+    if fast:
+        return HashDropout(rate, name=name)
+    return nn.Dropout(rate, name=name)
 
 
 class HashDropout(nn.Module):

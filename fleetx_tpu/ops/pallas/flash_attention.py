@@ -172,6 +172,26 @@ def _score_mask(q_pos, k_pos, kvlen, causal: bool):
     return mask
 
 
+def fit_blocks(s: int, want_q: int, want_k: int):
+    """Largest (block_q, block_k) <= the requested sizes with
+    block_k | block_q | s — so sequence lengths that are NOT multiples of
+    the default 512 (e.g. 768, 1920) shrink the tile instead of being
+    demoted to the XLA fallback path. Returns (None, None) when no 8-row
+    tile divides ``s``. Trace-time Python only."""
+    want_q = min(want_q, s)
+    want_k = min(want_k, s, want_q)  # block_k | block_q requires bk <= bq
+    block_k = next(
+        (bk for bk in range(want_k - want_k % 8, 7, -8) if s % bk == 0), None
+    )
+    if block_k is None:
+        return None, None
+    block_q = next(
+        bq for bq in range(want_q - want_q % block_k, 0, -block_k)
+        if s % bq == 0
+    )  # always terminates: bq == block_k divides s
+    return block_q, block_k
+
+
 def _major_block(s: int, tile: int, want: int) -> int:
     """Largest multiple of ``tile`` that divides ``s`` and is <= want
     (but at least ``tile``): the resident-block row count."""
@@ -670,10 +690,9 @@ def flash_attention(
     gives bidirectional (encoder) attention. ``dropout_rate > 0`` requires a
     ``dropout_rng`` key; the mask is generated inside the kernel."""
     b, s, h, _ = q.shape
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    if s % block_q or s % block_k or block_q % block_k:
-        raise ValueError(f"seq {s} not tileable by ({block_q}, {block_k})")
+    block_q, block_k = fit_blocks(s, block_q, block_k)
+    if block_q is None:
+        raise ValueError(f"seq {s} not tileable (must be a multiple of 8)")
     if dropout_rate > 0.0:
         if dropout_rng is None:
             raise ValueError("dropout_rate > 0 requires dropout_rng")

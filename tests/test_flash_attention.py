@@ -68,9 +68,22 @@ def test_bf16_inputs():
 
 
 def test_untileable_seq_raises():
-    q, k, v = _qkv(s=200)
+    # no 8-row tile divides 100 (100 % 8 != 0): the only untileable case
+    # left now that blocks shrink to the largest divisor of the sequence
+    q, k, v = _qkv(s=100)
     with pytest.raises(ValueError):
         flash_attention(q, k, v, block_q=128, block_k=128)
+
+
+def test_formerly_untileable_seq_now_shrinks_blocks():
+    # s=200 used to raise at 128-blocks; fit_blocks now picks 40x40
+    q, k, v = _qkv(s=200)
+    out = flash_attention(q, k, v, block_q=128, block_k=128)
+    ref = _reference_attention(q, k, v, causal=True, attn_mask=None,
+                               dropout_rate=0.0, dropout_rng=None,
+                               deterministic=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
 
 # ---------------------------------------------------------------- dropout
 
@@ -346,3 +359,37 @@ def test_bf16_grads_match_reference():
             np.asarray(a, np.float32), np.asarray(b, np.float32),
             rtol=1e-1, atol=1e-1, err_msg=f"d{name} mismatch",
         )
+
+
+def test_fit_blocks_shrinks_for_non_multiple_seqs():
+    """Seqs that are multiples of 128 but not 512 stay on the flash path
+    (blocks shrink to the largest divisor instead of demoting to XLA)."""
+    from fleetx_tpu.ops.pallas.flash_attention import fit_blocks
+
+    bq, bk = fit_blocks(768, 512, 512)
+    assert bq % bk == 0 and 768 % bq == 0 and 768 % bk == 0 and bk >= 128
+    bq, bk = fit_blocks(1920, 512, 512)
+    assert bq % bk == 0 and 1920 % bq == 0
+    assert fit_blocks(12, 512, 512) == (None, None)  # no 8-row tile divides
+    # asymmetric request: block_k capped at block_q
+    bq, bk = fit_blocks(1024, 128, 512)
+    assert bk <= bq and 1024 % bq == 0
+
+
+def test_flash_odd_seq_parity():
+    """768-seq (not a multiple of the 512 default) runs the kernel and
+    matches the XLA reference."""
+    import numpy as np
+
+    from fleetx_tpu.ops.attention import _reference_attention
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 768, 2, 64), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 768, 2, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 768, 2, 64), jnp.float32)
+    out = flash_attention(q, k, v, causal=True)
+    ref = _reference_attention(q, k, v, causal=True, attn_mask=None,
+                               dropout_rate=0.0, dropout_rng=None,
+                               deterministic=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)

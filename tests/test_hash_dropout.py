@@ -106,3 +106,12 @@ def test_model_level_determinism():
     c = model.apply(params, tokens, deterministic=False, rngs={"dropout": k2})
     np.testing.assert_allclose(np.asarray(a), np.asarray(b))
     assert not np.allclose(np.asarray(a), np.asarray(c))
+
+
+def test_fast_dropout_false_restores_nn_dropout():
+    from flax import linen as nn
+
+    from fleetx_tpu.ops.dropout import dropout_layer
+
+    assert isinstance(dropout_layer(0.1, "d", False), nn.Dropout)
+    assert isinstance(dropout_layer(0.1, "d", True), HashDropout)

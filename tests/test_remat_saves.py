@@ -72,3 +72,10 @@ def test_from_model_config_parses_csv_and_list():
     b = GPTConfig.from_model_config(
         {"vocab_size": 128, "recompute_extra_saves": ["mlp_out"]})
     assert b.recompute_extra_saves == ("mlp_out",)
+
+
+def test_unknown_save_name_raises():
+    import pytest
+
+    with pytest.raises(ValueError, match="checkpoint_name"):
+        _remat_policy(_cfg(recompute_extra_saves=("qkv",)))
