@@ -130,15 +130,52 @@ def _kernel(log_dir: str, top_k: int = 15):
         pid for pid, name in proc_names.items()
         if any(s in name for s in ("TPU", "GPU", "/device:", "XLA Op"))
     }
-    totals = defaultdict(float)
-    counts = defaultdict(int)
+    # the 'XLA Ops' line holds the LEAF per-op events; module/step lines
+    # ('XLA Modules', 'Steps', jit_* wrappers) span entire steps and would
+    # double-count everything beneath them
+    thread_names = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            thread_names[(ev.get("pid"), ev.get("tid"))] = (
+                (ev.get("args") or {}).get("name", "")
+            )
+    op_tracks = {
+        key for key, name in thread_names.items() if name == "XLA Ops"
+    }
+    # SELF time per op: complete events on one track nest (jit_train_step >
+    # while > fusion), so naive dur sums double-count every level. Per
+    # (pid, tid), sweep events in start order with an enclosing-interval
+    # stack; each event's self time is its duration minus its direct
+    # children's spans.
+    per_track = defaultdict(list)
     for ev in events:
         if ev.get("ph") != "X" or "dur" not in ev:
             continue
         if device_pids and ev.get("pid") not in device_pids:
             continue
-        totals[ev["name"]] += ev["dur"]
-        counts[ev["name"]] += 1
+        key = (ev.get("pid"), ev.get("tid"))
+        if op_tracks and key not in op_tracks:
+            continue
+        per_track[key].append(ev)
+    totals = defaultdict(float)
+    counts = defaultdict(int)
+    for track in per_track.values():
+        track.sort(key=lambda e: (e["ts"], -e["dur"]))
+        open_nodes = []  # [name, end_ts, child_total, dur]
+
+        def _close(node):
+            totals[node[0]] += max(0.0, node[3] - node[2])
+            counts[node[0]] += 1
+
+        for ev in track:
+            ts, dur = ev["ts"], ev["dur"]
+            while open_nodes and ts >= open_nodes[-1][1] - 1e-9:
+                _close(open_nodes.pop())
+            if open_nodes:
+                open_nodes[-1][2] += dur  # child span off the parent's self
+            open_nodes.append([ev["name"], ts + dur, 0.0, dur])
+        while open_nodes:
+            _close(open_nodes.pop())
     if not totals:
         logger.info("trace had no complete device events")
         return
