@@ -1,0 +1,95 @@
+"""Kernel-view trace parsing (utils/profiler_summary.py): leaf-op self
+time, no double counting from module/step wrapper lines."""
+
+import gzip
+import json
+import logging
+import os
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _propagate_logger():
+    # the fleetx_tpu logger sets propagate=False; caplog needs propagation
+    from fleetx_tpu.utils.log import logger
+
+    logger.propagate = True
+    yield
+    logger.propagate = False
+
+
+def _write_trace(tmp_path, events):
+    d = tmp_path / "plugins" / "profile" / "run1"
+    os.makedirs(d)
+    with gzip.open(d / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return str(tmp_path)
+
+
+def _meta(pid, tid=None, pname=None, tname=None):
+    if pname is not None:
+        return {"ph": "M", "pid": pid, "name": "process_name",
+                "args": {"name": pname}}
+    return {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+            "args": {"name": tname}}
+
+
+def test_kernel_view_uses_leaf_ops_and_self_time(tmp_path, caplog):
+    from fleetx_tpu.utils.profiler_summary import _kernel
+
+    events = [
+        _meta(3, pname="/device:TPU:0"),
+        _meta(701, pname="/host:CPU"),
+        _meta(3, tid=1, tname="XLA Modules"),
+        _meta(3, tid=2, tname="XLA Ops"),
+        _meta(701, tid=9, tname="python"),
+        # module wrapper spanning the whole step: must NOT dominate
+        {"ph": "X", "pid": 3, "tid": 1, "name": "jit_step", "ts": 0,
+         "dur": 1000},
+        # leaf ops: matmul twice (300 us), attn once (500 us)
+        {"ph": "X", "pid": 3, "tid": 2, "name": "matmul", "ts": 0, "dur": 150},
+        {"ph": "X", "pid": 3, "tid": 2, "name": "attn", "ts": 150, "dur": 500},
+        {"ph": "X", "pid": 3, "tid": 2, "name": "matmul", "ts": 650,
+         "dur": 150},
+        # host python event: excluded entirely
+        {"ph": "X", "pid": 701, "tid": 9, "name": "host_stuff", "ts": 0,
+         "dur": 10**6},
+    ]
+    log_dir = _write_trace(tmp_path, events)
+    with caplog.at_level(logging.INFO, logger="fleetx_tpu"):
+        _kernel(log_dir, top_k=5)
+    text = caplog.text
+    assert "attn" in text and "matmul" in text
+    assert "jit_step" not in text       # wrapper line filtered out
+    assert "host_stuff" not in text     # host process filtered out
+    # attn 500 of 800 leaf us = 62.5%
+    attn_line = next(l for l in text.splitlines() if " attn " in l or
+                     l.rstrip().split()[-4:] and "attn" in l.split()[2:3])
+    assert "62.5%" in attn_line
+
+
+def test_kernel_view_nested_events_on_one_track(tmp_path, caplog):
+    """If leaf-line events nest, the child span comes off the parent."""
+    from fleetx_tpu.utils.profiler_summary import _kernel
+
+    events = [
+        _meta(3, pname="/device:TPU:0"),
+        _meta(3, tid=2, tname="XLA Ops"),
+        {"ph": "X", "pid": 3, "tid": 2, "name": "outer", "ts": 0, "dur": 100},
+        {"ph": "X", "pid": 3, "tid": 2, "name": "inner", "ts": 10, "dur": 80},
+    ]
+    log_dir = _write_trace(tmp_path, events)
+    with caplog.at_level(logging.INFO, logger="fleetx_tpu"):
+        _kernel(log_dir, top_k=5)
+    text = caplog.text
+    # outer self = 20 us, inner = 80 us → inner 80%, outer 20%
+    assert "80.0%" in text and "20.0%" in text
+
+
+def test_kernel_view_no_trace(tmp_path, caplog):
+    from fleetx_tpu.utils.profiler_summary import _kernel
+
+    with caplog.at_level(logging.INFO, logger="fleetx_tpu"):
+        _kernel(str(tmp_path))
+    assert "no trace found" in caplog.text
