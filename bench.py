@@ -111,6 +111,10 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
             # e.g. BENCH_EXTRA_SAVES=qkv_out,ffn_gelu : spend HBM on saved
             # activations to cut backward recompute (docs/PERFORMANCE.md)
             recompute_extra_saves=os.environ.get("BENCH_EXTRA_SAVES"),
+            # BENCH_SCAN=0 unrolls the layer stack: slower compile, but no
+            # scan-carry dynamic-update-slice traffic (~9%/step in the r4
+            # profile at 345M)
+            scan_layers=os.environ.get("BENCH_SCAN", "1") == "1",
         ),
         Optimizer=AttrDict(
             name="FusedAdamW",
