@@ -16,14 +16,17 @@ Two masking modes, both resolved inside the kernels:
   builds it from ``input_ids != pad``), so bidirectional ERNIE-style
   encoders ride the flash path too (``causal=False`` + kv_lens).
 
-Attention dropout runs *inside* the kernel: a counter-based integer hash
-(lowbias32 finalizer) of (seed, batch*head, q_pos, k_pos) produces the keep
-mask, so the backward kernels regenerate the identical mask from the same
-seed with zero extra HBM traffic — the reference reaches the same
-determinism via its CUDA RNG tracker ``local_seed``
-(/root/reference/ppfleetx/distributed/apis/env.py:49-54). The hash is plain
-int32 arithmetic, so the kernel behaves identically under the Pallas
-interpreter on CPU (where pltpu.prng_* has no lowering) and on real TPUs.
+Attention dropout runs *inside* the kernel with zero extra HBM traffic —
+the reference reaches the same determinism via its CUDA RNG tracker
+``local_seed`` (/root/reference/ppfleetx/distributed/apis/env.py:49-54).
+Two deterministic bit sources:
+- real TPUs: the hardware PRNG (``pltpu.prng_seed/prng_random_bits``),
+  seeded per (seed, batch*head, q-tile, k-tile) so the forward and both
+  backward kernels regenerate identical bits for congruent tiles
+  (``FLEETX_FLASH_HW_RNG=0`` opts out);
+- CPU interpreter (and the opt-out): a counter-based integer hash
+  (lowbias32 finalizer) of (seed, batch*head, q_pos, k_pos) — plain int32
+  arithmetic the host-side tests reproduce bit-for-bit.
 
 Layout: q, k, v are [batch, seq, heads, head_dim] (model layout).
 
@@ -157,6 +160,33 @@ def dropout_keep_scale(seed, bh, q_pos, k_pos, rate: float):
     return keep.astype(jnp.float32) / (1.0 - rate)
 
 
+# Attention-dropout bit source on real TPUs: the hardware PRNG
+# (pltpu.prng_seed/prng_random_bits), seeded per (seed, batch*head, q-tile,
+# k-tile) so the forward and both backward kernels regenerate identical
+# bits for congruent tiles. FLEETX_FLASH_HW_RNG=0 forces the lowbias32
+# hash everywhere (the interpreter always uses it: pltpu.prng_* has no CPU
+# lowering), which is also what the CPU parity tests validate bit-for-bit.
+HW_RNG = _os.environ.get("FLEETX_FLASH_HW_RNG", "1") == "1"
+
+
+def _tile_keep_scale(seed, bh, qb, kb, q_col, k_row, shape, rate: float):
+    """Dropout keep/scale for one [block_q, block_k] score tile.
+
+    seed/bh: int32 scalars; qb/kb: GLOBAL tile indices (int32, traced);
+    q_col/k_row: [bq, 1] / [1, bk] global positions for the hash fallback.
+    All three kernels tile scores congruently ([block_q, block_k], q rows x
+    k cols), so (qb, kb) identifies the same cells everywhere.
+    """
+    if HW_RNG and not _interpret():
+        pltpu.prng_seed(seed, bh, qb, kb)
+        bits = pltpu.prng_random_bits(shape)
+        bits = jax.lax.bitcast_convert_type(bits, jnp.int32)
+        threshold = jnp.int32(int(rate * (1 << 31)))
+        keep = (bits & jnp.int32(0x7FFFFFFF)) >= threshold
+        return keep.astype(jnp.float32) / (1.0 - rate)
+    return dropout_keep_scale(seed, bh, q_col, k_row, rate)
+
+
 def _mm_dtype(dtype):
     """MXU operand dtype: bf16 operands run the MXU at full rate (f32
     accumulation comes from preferred_element_type); any other input dtype
@@ -276,8 +306,10 @@ def _fwd_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             # dropout scales only the value path (out = drop(softmax(s)) @ v).
             l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
             if dropout_rate > 0.0:
-                p = p * dropout_keep_scale(seed_ref[0], bh, q_col, k_row,
-                                           dropout_rate)
+                p = p * _tile_keep_scale(
+                    seed_ref[0], bh, i, jm * tiles + t, q_col, k_row,
+                    (bq, block_k), dropout_rate,
+                )
             acc_new = alpha * acc + jax.lax.dot_general(
                 p.astype(mm_dt), v_blk, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -367,8 +399,10 @@ def _bwd_dq_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             if dropout_rate > 0.0:
                 # dP = (dO @ V^T) ∘ mask; delta already equals rowsum(P ∘ dP)
                 # because delta = rowsum(dO ∘ O) and O = (P ∘ mask) @ V.
-                dp = dp * dropout_keep_scale(seed_ref[0], bh, q_col, k_row,
-                                             dropout_rate)
+                dp = dp * _tile_keep_scale(
+                    seed_ref[0], bh, i, jm * tiles + t, q_col, k_row,
+                    (bq, block_k), dropout_rate,
+                )
             ds = p * (dp - delta)
             return dq + jax.lax.dot_general(
                 ds.astype(mm_dt), k_blk, (((1,), (0,)), ((), ())),
@@ -465,8 +499,10 @@ def _bwd_dkv_kernel(seed_ref, kvlens_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                 preferred_element_type=jnp.float32,
             )
             if dropout_rate > 0.0:
-                drop = dropout_keep_scale(seed_ref[0], bh, q_col, k_row,
-                                          dropout_rate)
+                drop = _tile_keep_scale(
+                    seed_ref[0], bh, im * tiles + t, j, q_col, k_row,
+                    (block_q, bk), dropout_rate,
+                )
                 p_v = p * drop  # dropped probabilities feed dV
                 dp = dp * drop
             else:
