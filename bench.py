@@ -158,6 +158,11 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
 
     tokens_per_sec = gbs * seq * steps / dt
     n_chips = jax.device_count()
+    try:  # peak HBM: how much headroom a remat save-set / batch bump has
+        stats = jax.devices()[0].memory_stats() or {}
+        peak_hbm_gb = round(stats.get("peak_bytes_in_use", 0) / 2**30, 2)
+    except Exception:
+        peak_hbm_gb = None
     flops_per_token = model_flops_per_token(
         n_params, cfg.Model.num_layers, seq, cfg.Model.hidden_size
     )
@@ -179,6 +184,7 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
             "loss": round(final_loss, 4),
             "mfu": round(mfu, 4),
             "tflops_per_chip": round(achieved_flops / n_chips / 1e12, 2),
+            "peak_hbm_gb": peak_hbm_gb,
             "model_flops_per_token": round(flops_per_token / 1e9, 3),
             "flops_accounting": "model-flops (remat forward excluded)",
             "recompute": f"{recompute}:{granularity}",
