@@ -476,3 +476,38 @@ def test_hw_rng_grads_match_finite_differences():
         an = float(jnp.sum(grads[idx] * t))
         np.testing.assert_allclose(an, fd, rtol=5e-2, atol=5e-1,
                                    err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_dropout_with_kv_lens_matches_reference(hash_rng, causal):
+    """Dropout and the kv_lens key mask compose: masked cells stay exactly
+    zero, surviving cells carry the hash keep/scale."""
+    from fleetx_tpu.ops.pallas.flash_attention import dropout_keep_scale
+
+    q, k, v = _qkv(s=256, d=32)
+    kv_lens = jnp.asarray([100, 256], jnp.int32)
+    rng = jax.random.PRNGKey(21)
+    rate = 0.2
+    seed = jax.random.bits(rng, (1,), "uint32").astype(jnp.int32)[0]
+    out = flash_attention(q, k, v, causal=causal, kv_lens=kv_lens,
+                          dropout_rate=rate, dropout_rng=rng)
+
+    b, s, h, d = q.shape
+    scale = 1.0 / (d**0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    qp = jnp.arange(s, dtype=jnp.int32)[:, None]
+    kp = jnp.arange(s, dtype=jnp.int32)[None, :]
+    mask = (kp < kv_lens[:, None, None, None])
+    if causal:
+        mask = mask & (qp >= kp)
+    scores = jnp.where(mask, scores, -1e30)
+    p = jax.nn.softmax(scores, -1)
+    p = jnp.where(mask, p, 0.0)  # fully-masked rows: zeros, not uniform
+    bh = (jnp.arange(b)[:, None] * h
+          + jnp.arange(h)[None, :]).astype(jnp.int32)
+    drop = dropout_keep_scale(
+        seed, bh[:, :, None, None], qp[None, None], kp[None, None], rate
+    )
+    ref = jnp.einsum("bhqk,bkhd->bqhd", p * drop, v.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref, q.dtype),
+                               rtol=2e-4, atol=2e-5)
