@@ -1,0 +1,43 @@
+"""Optimizer.moment_dtype: bf16 first moment (optims/optimizer.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fleetx_tpu.optims.optimizer import build_optimizer
+
+_LR = {"name": "CosineAnnealingWithWarmupDecay", "decay_steps": 100,
+       "max_lr": 1e-4, "min_lr": 1e-5}
+
+
+def _moments_dtypes(cfg):
+    tx = build_optimizer(cfg)
+    st = tx.init({"w": jnp.ones((8, 8))})
+    return {str(l.dtype) for l in jax.tree.leaves(st)
+            if hasattr(l, "dtype") and l.dtype != jnp.int32}
+
+
+def test_default_moments_are_f32():
+    assert _moments_dtypes(
+        {"name": "AdamW", "weight_decay": 0.01, "lr": _LR}
+    ) == {"float32"}
+
+
+def test_bf16_moment_dtype():
+    dts = _moments_dtypes(
+        {"name": "AdamW", "weight_decay": 0.01, "lr": _LR,
+         "moment_dtype": "bfloat16"}
+    )
+    assert "bfloat16" in dts      # mu
+    assert "float32" in dts       # nu stays full precision
+
+
+def test_updates_stay_f32_and_finite():
+    tx = build_optimizer({"name": "AdamW", "weight_decay": 0.01, "lr": _LR,
+                          "moment_dtype": "bfloat16"})
+    params = {"w": jnp.ones((8, 8))}
+    st = tx.init(params)
+    for i in range(3):
+        up, st = tx.update({"w": jnp.full((8, 8), 0.1)}, st, params)
+    assert jax.tree.leaves(up)[0].dtype == jnp.float32
+    assert np.isfinite(np.asarray(jax.tree.leaves(up)[0])).all()
