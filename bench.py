@@ -118,6 +118,9 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
         ),
         Optimizer=AttrDict(
             name="FusedAdamW",
+            # BENCH_MOMENT_DTYPE=bfloat16 halves the Adam mu buffer —
+            # headroom for remat save-sets (docs/PERFORMANCE.md)
+            moment_dtype=os.environ.get("BENCH_MOMENT_DTYPE"),
             weight_decay=0.01,
             lr=AttrDict(name="CosineAnnealingWithWarmupDecay", decay_steps=360000,
                         max_lr=5e-5, min_lr=1e-5),
