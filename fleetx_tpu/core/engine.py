@@ -19,7 +19,6 @@ import os
 import time
 from typing import Any, Dict, Iterable, Optional
 
-import flax
 import jax
 import jax.numpy as jnp
 import numpy as np

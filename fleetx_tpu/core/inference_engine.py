@@ -10,7 +10,7 @@ is the optimizing backend where the reference plugs TensorRT."""
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict
 
 import jax
 import numpy as np

@@ -17,7 +17,7 @@ checkpointing RNG state.
 from __future__ import annotations
 
 import os
-from typing import Optional, Sequence
+from typing import Optional
 
 import numpy as np
 

@@ -11,7 +11,7 @@ reproduces the reference's data-order recovery.
 
 from __future__ import annotations
 
-from typing import Iterator, List, Optional
+from typing import Iterator, List
 
 import numpy as np
 

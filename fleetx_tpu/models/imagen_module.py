@@ -21,7 +21,6 @@ from fleetx_tpu.models.language_module import resolve_compute_dtype
 from fleetx_tpu.models.module import BasicModule
 from fleetx_tpu.models.multimodal.imagen import imagen_criterion, q_sample
 from fleetx_tpu.models.multimodal.unet import (
-    UNET_PRESETS,
     UNetConfig,
     EfficientUNet,
     build_unet,

@@ -9,7 +9,6 @@ annotation-driven.
 
 from __future__ import annotations
 
-import time
 from typing import Dict
 
 import jax

@@ -7,7 +7,7 @@ construction, loss, and batch pre/post hooks, not the training loop.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 

@@ -18,7 +18,7 @@ standard TPU data-hall recipe and keeps the train step text-model-free).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Tuple, Union
+from typing import Any, Tuple, Union
 
 import flax.linen as nn
 import jax

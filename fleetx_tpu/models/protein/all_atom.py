@@ -11,7 +11,7 @@ struct-of-scalars r3.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict
 
 import jax.numpy as jnp
 

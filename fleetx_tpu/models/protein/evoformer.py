@@ -23,7 +23,7 @@ Tensor shapes (batch-first, TPU layout):
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Tuple
+from typing import Any
 
 import flax.linen as nn
 import jax

@@ -10,7 +10,7 @@ _batch_shuffle; GroupNorm has no cross-sample statistics to leak)."""
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Sequence, Tuple
+from typing import Any, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 from fleetx_tpu.models.language_module import resolve_compute_dtype
 from fleetx_tpu.models.module import BasicModule
-from fleetx_tpu.models.vision.vit import ViTConfig, ViT, VIT_PRESETS, build_vision_model
+from fleetx_tpu.models.vision.vit import ViTConfig, ViT, build_vision_model
 from fleetx_tpu.utils.log import logger
 
 __all__ = ["GeneralClsModule"]

@@ -11,7 +11,6 @@ lets long-context configs run without the reference's recompute tricks.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

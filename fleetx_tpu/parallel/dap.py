@@ -24,7 +24,6 @@ formulation provided for the cases that still want it.
 
 from __future__ import annotations
 
-import jax
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 

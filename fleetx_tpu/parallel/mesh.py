@@ -22,7 +22,7 @@ neighbors, pp outermost so stage p2p can cross DCN.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import numpy as np

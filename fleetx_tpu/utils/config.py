@@ -16,7 +16,7 @@ import argparse
 import codecs
 import copy
 import os
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, List, Optional, Sequence
 
 import yaml
 
