@@ -19,20 +19,25 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Each point: base BENCH_* env overrides. No-remat at 345M OOMs v5e 16GiB
+# (benchmarks/preflight_r04.json), so the sweep stays on selective remat
+# and walks batch x flash blocks x remat save-set x optimizer-moment dtype
+# x scan-vs-unrolled (docs/PERFORMANCE.md). 512x512 b16 measured best
+# (25.5k tok/s / 29.6% MFU) before the save-set/moment/scan knobs existed.
 SWEEP = [
-    # (batch, granularity, block_q, block_k, extra_saves)
-    # no-remat at 345M OOMs v5e 16GiB (benchmarks/preflight_r04.json), so
-    # the sweep stays on selective remat and walks batch x flash blocks x
-    # remat save-set (docs/PERFORMANCE.md). 512x512 b16 measured best
-    # (25.5k tok/s / 29.6% MFU) before the extra-saves knob existed.
-    (8, "core_attn", 512, 512, ""),
-    (8, "core_attn", 512, 512, "qkv_out,ffn_gelu"),
-    (8, "core_attn", 512, 512, "qkv_out,ffn_gelu,mlp_out,attn_out"),
-    (16, "core_attn", 512, 512, ""),
-    (16, "core_attn", 512, 512, "qkv_out"),
-    (16, "core_attn", 512, 512, "qkv_out,ffn_gelu"),
-    (16, "core_attn", 256, 256, ""),
-    (32, "core_attn", 512, 512, ""),
+    {"BENCH_BATCH": "8"},
+    {"BENCH_BATCH": "8", "BENCH_EXTRA_SAVES": "qkv_out,ffn_gelu"},
+    {"BENCH_BATCH": "8",
+     "BENCH_EXTRA_SAVES": "qkv_out,ffn_gelu,mlp_out,attn_out",
+     "BENCH_MOMENT_DTYPE": "bfloat16"},
+    {"BENCH_BATCH": "16"},
+    {"BENCH_BATCH": "16", "BENCH_EXTRA_SAVES": "qkv_out"},
+    {"BENCH_BATCH": "16", "BENCH_EXTRA_SAVES": "qkv_out,ffn_gelu",
+     "BENCH_MOMENT_DTYPE": "bfloat16"},
+    {"BENCH_BATCH": "16", "BENCH_SCAN": "0"},
+    {"BENCH_BATCH": "16", "FLEETX_FLASH_BLOCK_Q": "256",
+     "FLEETX_FLASH_BLOCK_K": "256"},
+    {"BENCH_BATCH": "32"},
 ]
 
 
@@ -56,18 +61,18 @@ def main():
         return
     print("== bench sweep ==", flush=True)
     best = None
-    for batch, gran, bq, bk, saves in SWEEP:
+    for point in SWEEP:
         env = {
             **os.environ,
-            "BENCH_BATCH": str(batch), "BENCH_RECOMPUTE": "1",
-            "BENCH_GRANULARITY": gran, "BENCH_STEPS": args.steps,
-            "FLEETX_FLASH_BLOCK_Q": str(bq), "FLEETX_FLASH_BLOCK_K": str(bk),
-            "BENCH_EXTRA_SAVES": saves,
+            "BENCH_RECOMPUTE": "1", "BENCH_GRANULARITY": "core_attn",
+            "BENCH_STEPS": args.steps,
             # sweep wants the anchor train record only — no decode bench,
             # no second-batch record (they triple the per-point wall time)
             "BENCH_EXTRA": "0",
+            **point,
         }
-        tag = f"b{batch} rec={gran} blk={bq}x{bk} saves={saves or '-'}"
+        tag = " ".join(f"{k.replace('BENCH_', '').replace('FLEETX_FLASH_', '').lower()}={v}"
+                       for k, v in point.items())
         try:
             p = subprocess.run(
                 [sys.executable, "bench.py"], cwd=REPO, env=env,
