@@ -72,6 +72,10 @@ def _acquire_devices_or_die(timeout_s: int):
     )
 
 
+# process-lifetime high-water mark already attributed to an earlier record
+_PEAK_SEEN = [0]
+
+
 def train_record(batch: int, *, seq: int, steps: int, warmup: int,
                  recompute: bool, granularity: str) -> dict:
     """Build the 345M trainer at ``batch`` and time ``steps`` train steps."""
@@ -161,9 +165,18 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
 
     tokens_per_sec = gbs * seq * steps / dt
     n_chips = jax.device_count()
-    try:  # peak HBM: how much headroom a remat save-set / batch bump has
+    # peak HBM: how much headroom a remat save-set / batch bump has.
+    # peak_bytes_in_use is PROCESS-lifetime-monotone, so a second in-process
+    # record only reports a number when it actually set a new peak
+    # (peak_before captured in the caller); None = unavailable or masked.
+    try:
         stats = jax.devices()[0].memory_stats() or {}
-        peak_hbm_gb = round(stats.get("peak_bytes_in_use", 0) / 2**30, 2)
+        peak = stats.get("peak_bytes_in_use")
+        if peak is None or peak <= _PEAK_SEEN[0]:
+            peak_hbm_gb = None
+        else:
+            peak_hbm_gb = round(peak / 2**30, 2)
+            _PEAK_SEEN[0] = peak
     except Exception:
         peak_hbm_gb = None
     flops_per_token = model_flops_per_token(

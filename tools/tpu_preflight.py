@@ -64,8 +64,14 @@ def main():
     for point in SWEEP:
         env = {
             **os.environ,
-            "BENCH_RECOMPUTE": "1", "BENCH_GRANULARITY": "core_attn",
-            "BENCH_STEPS": args.steps,
+            # pin EVERY swept knob to its default first: an ambient
+            # BENCH_*/FLEETX_FLASH_* export from earlier experimentation
+            # must not silently skew points whose tag claims defaults
+            "BENCH_BATCH": "8", "BENCH_RECOMPUTE": "1",
+            "BENCH_GRANULARITY": "core_attn", "BENCH_STEPS": args.steps,
+            "BENCH_EXTRA_SAVES": "", "BENCH_MOMENT_DTYPE": "",
+            "BENCH_SCAN": "1",
+            "FLEETX_FLASH_BLOCK_Q": "512", "FLEETX_FLASH_BLOCK_K": "512",
             # sweep wants the anchor train record only — no decode bench,
             # no second-batch record (they triple the per-point wall time)
             "BENCH_EXTRA": "0",
