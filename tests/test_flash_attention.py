@@ -511,3 +511,21 @@ def test_dropout_with_kv_lens_matches_reference(hash_rng, causal):
     ref = jnp.einsum("bhqk,bkhd->bqhd", p * drop, v.astype(jnp.float32))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref, q.dtype),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_fit_blocks_invariants_sweep():
+    """For every 8-multiple sequence up to 4k: blocks divide s, block_k
+    divides block_q, both within requested bounds; non-8-multiples give
+    (None, None)."""
+    from fleetx_tpu.ops.pallas.flash_attention import fit_blocks
+
+    for s in range(8, 4097, 8):
+        for want_q, want_k in ((512, 512), (128, 128), (256, 128), (128, 512)):
+            bq, bk = fit_blocks(s, want_q, want_k)
+            assert bq is not None, (s, want_q, want_k)
+            assert s % bq == 0 and s % bk == 0 and bq % bk == 0
+            assert bq <= min(want_q, s) and bk <= min(want_k, s, bq)
+            assert bq % 8 == 0 and bk % 8 == 0
+    for s in (4, 12, 20, 100, 1001):
+        if s % 8:
+            assert fit_blocks(s, 512, 512) == (None, None)

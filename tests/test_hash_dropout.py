@@ -115,3 +115,26 @@ def test_fast_dropout_false_restores_nn_dropout():
 
     assert isinstance(dropout_layer(0.1, "d", False), nn.Dropout)
     assert isinstance(dropout_layer(0.1, "d", True), HashDropout)
+
+
+def test_fast_dropout_false_end_to_end():
+    """The nn.Dropout rollback path still trains (GPT forward+backward)."""
+    from fleetx_tpu.models.gpt.model import GPTConfig, GPTForPretraining
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_attention_heads=4, ffn_hidden_size=128,
+                    max_position_embeddings=32, hidden_dropout_prob=0.2,
+                    attention_probs_dropout_prob=0.0, dtype=jnp.float32,
+                    fast_dropout=False)
+    model = GPTForPretraining(cfg)
+    tokens = jnp.arange(32)[None, :] % 128
+    params = model.init(jax.random.PRNGKey(0), tokens)
+
+    def loss(params):
+        logits = model.apply(params, tokens, deterministic=False,
+                             rngs={"dropout": jax.random.PRNGKey(1)})
+        return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+    l, g = jax.value_and_grad(loss)(params)
+    assert np.isfinite(float(l))
+    assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(g))
