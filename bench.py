@@ -155,7 +155,8 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
     state = trainer.state
     for i in range(warmup):
         state, metrics = step_fn(state, db, dist_env.data_rank_key(i))
-    float(jax.device_get(metrics["loss"]))  # host transfer = hard sync
+    if warmup:  # host transfer = hard sync (BENCH_WARMUP=0 skips cleanly)
+        float(jax.device_get(metrics["loss"]))
 
     t0 = time.perf_counter()
     for i in range(steps):

@@ -438,8 +438,15 @@ class Trainer:
         import jax
 
         def _aval(x):
-            return (jax.ShapeDtypeStruct(x.shape, x.dtype)
-                    if hasattr(x, "shape") and hasattr(x, "dtype") else x)
+            if not (hasattr(x, "shape") and hasattr(x, "dtype")):
+                return x
+            # keep NamedShardings: cost_analysis re-lowers from these avals,
+            # and shardingless avals would be a cache MISS (full recompile)
+            # of a differently-GSPMD-partitioned program
+            sh = getattr(x, "sharding", None)
+            if isinstance(sh, jax.sharding.NamedSharding):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
 
         def call(*args, **kwargs):
             if name is not None and name not in self._abstract_args:

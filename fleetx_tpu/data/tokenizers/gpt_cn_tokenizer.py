@@ -73,8 +73,13 @@ class SentencePieceUnigram:
             if p.type == 2:  # UNKNOWN
                 unk_id, unk_piece = i, p.piece
                 continue
-            if p.type != 1:  # CONTROL/USER_DEFINED/BYTE keep ids, no score
-                continue
+            if p.type == 3 or p.type == 5:  # CONTROL/UNUSED: id only, never
+                continue                    # segmented from raw text
+            # NORMAL(1) keeps its trained log-prob; USER_DEFINED(4) and
+            # BYTE(6) must stay reachable in the Viterbi too — real
+            # sentencepiece segments user-defined pieces with their stored
+            # score (0.0, i.e. maximally preferred), and byte pieces are
+            # the <unk> fallback alphabet
             pieces[p.piece] = p.score
         escape = True
         if proto.HasField("normalizer_spec") and proto.normalizer_spec.HasField(

@@ -86,15 +86,20 @@ def causal_attention(
 
     def _tileable(s: int) -> bool:
         # mirror flash_attention's block fitting: blocks shrink to the
-        # largest divisor of the sequence, so only sequences with no 8-row
-        # tile at all (s % 8 != 0 or s < 8) fall back to XLA
+        # largest divisor of the sequence. Route to the kernel only when a
+        # reasonably-sized tile fits — a sequence like 1016 = 8*127 only
+        # admits 8-row tiles, where per-grid-step overhead makes the
+        # kernel slower than the XLA path it would replace.
         from fleetx_tpu.ops.pallas.flash_attention import (
             DEFAULT_BLOCK_K,
             DEFAULT_BLOCK_Q,
             fit_blocks,
         )
 
-        return fit_blocks(s, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)[0] is not None
+        bq, bk = fit_blocks(s, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+        # bq == s: the whole sequence is one tile (short seqs) — no grid
+        # overhead regardless of size
+        return bq is not None and (bq >= 128 or bq == s)
 
     can_flash = (
         use_flash

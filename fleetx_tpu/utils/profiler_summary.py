@@ -142,6 +142,13 @@ def _kernel(log_dir: str, top_k: int = 15):
     op_tracks = {
         key for key, name in thread_names.items() if name == "XLA Ops"
     }
+    # no 'XLA Ops' line in this trace flavor: still drop the known
+    # step/module wrapper lines, whose events span whole steps and would
+    # bury the leaf ops in the self-time table
+    wrapper_tracks = {
+        key for key, name in thread_names.items()
+        if name in ("Steps", "XLA Modules", "Framework Ops")
+    }
     # SELF time per op: complete events on one track nest (jit_train_step >
     # while > fusion), so naive dur sums double-count every level. Per
     # (pid, tid), sweep events in start order with an enclosing-interval
@@ -154,7 +161,10 @@ def _kernel(log_dir: str, top_k: int = 15):
         if device_pids and ev.get("pid") not in device_pids:
             continue
         key = (ev.get("pid"), ev.get("tid"))
-        if op_tracks and key not in op_tracks:
+        if op_tracks:
+            if key not in op_tracks:
+                continue
+        elif key in wrapper_tracks:
             continue
         per_track[key].append(ev)
     totals = defaultdict(float)

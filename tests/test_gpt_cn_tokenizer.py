@@ -86,3 +86,36 @@ def test_eos_token_id_missing_raises(tmp_path):
     tok = GPTChineseTokenizer.from_pretrained(str(tmp_path))
     with pytest.raises(ValueError, match="append-eos"):
         tok.eos_token_id
+
+
+def test_user_defined_and_byte_pieces_are_segmentable(tmp_path):
+    """USER_DEFINED pieces (score 0.0 in the proto) must win the Viterbi —
+    real sentencepiece always extracts them; BYTE pieces stay reachable as
+    the fallback alphabet. Before the fix both were id-only and degraded
+    to <unk>."""
+    from transformers.utils import sentencepiece_model_pb2_new as pb2
+
+    proto = pb2.ModelProto()
+    unk = proto.pieces.add()
+    unk.piece = "<unk>"
+    unk.score = 0.0
+    unk.type = 2
+    ud = proto.pieces.add()
+    ud.piece = "<sep>"
+    ud.score = 0.0
+    ud.type = 4  # USER_DEFINED
+    byte = proto.pieces.add()
+    byte.piece = "<0x41>"
+    byte.score = -10.0
+    byte.type = 6  # BYTE
+    for piece, score in {"你": -3.0, "好": -3.0}.items():
+        p = proto.pieces.add()
+        p.piece = piece
+        p.score = score
+    path = tmp_path / "ud.model"
+    path.write_bytes(proto.SerializeToString())
+
+    sp = SentencePieceUnigram.from_file(str(path))
+    pieces = [sp.id_to_piece[i] for i in sp.encode("你<sep>好")]
+    assert pieces == ["你", "<sep>", "好"]
+    assert [sp.id_to_piece[i] for i in sp.encode("<0x41>")] == ["<0x41>"]
