@@ -529,3 +529,36 @@ def test_fit_blocks_invariants_sweep():
     for s in (4, 12, 20, 100, 1001):
         if s % 8:
             assert fit_blocks(s, 512, 512) == (None, None)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_kv_lens_across_major_blocks_512(causal):
+    """kv cuts landing in different 512-blocks (and mid-block) at seq 1024:
+    exercises the two-phase trip counts when n_kv_full differs per major."""
+    q, k, v = _qkv(b=2, s=1024, h=1, d=32)
+    kv_lens = jnp.asarray([100, 700], jnp.int32)
+    out = flash_attention(q, k, v, causal=causal, kv_lens=kv_lens,
+                          block_q=512, block_k=512)
+    ref = _ref_masked(q, k, v, kv_lens=kv_lens, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-4)
+
+
+def test_kv_lens_grads_across_major_blocks_512():
+    q, k, v = _qkv(b=2, s=1024, h=1, d=32)
+    kv_lens = jnp.asarray([100, 700], jnp.int32)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True, kv_lens=kv_lens,
+                                block_q=512, block_k=512) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_ref_masked(q, k, v, kv_lens=kv_lens, causal=True) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-2, atol=1e-3,
+            err_msg=f"d{name} mismatch",
+        )
