@@ -61,6 +61,12 @@ class HashDropout(nn.Module):
         seed = jax.random.bits(rng, (), "uint32").astype(jnp.int32)
         # element index as the hash counter; int32 covers activations up to
         # 2^31 elements (a [32, 2048, 12288] GPT-175B microbatch is 8e8)
+        if x.size >= (1 << 31):
+            raise ValueError(
+                f"HashDropout supports < 2^31 elements per call; got shape "
+                f"{x.shape} ({x.size}). Split the activation or use "
+                f"fast_dropout=False."
+            )
         idx = jax.lax.iota(jnp.int32, x.size).reshape(x.shape)
         scale = dropout_keep_scale(seed, jnp.int32(0), idx, jnp.int32(0),
                                    self.rate)
