@@ -1,0 +1,58 @@
+"""Device-acquisition watchdog (utils/device_guard.py): success path,
+fast-raise path (ADVICE r3 #3: the watchdog must not fire after a quick
+exception), and the hang path's loud exit-3 in a subprocess."""
+
+import os
+import subprocess
+import sys
+import time
+
+from fleetx_tpu.utils.device_guard import acquire_devices_or_die
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_success_returns_devices():
+    devices = acquire_devices_or_die(60, label="test",
+                                     platform_override="cpu")
+    assert len(devices) >= 1
+
+
+def test_fast_raise_does_not_arm_delayed_exit(monkeypatch):
+    """A quick exception must propagate AND the 1s watchdog must not
+    os._exit the process afterwards (acquired set in the finally)."""
+    import jax
+
+    def boom():
+        raise RuntimeError("no backend")
+
+    monkeypatch.setattr(jax, "devices", boom)
+    try:
+        acquire_devices_or_die(1, label="test")
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError:
+        pass
+    time.sleep(1.5)  # outlive the watchdog window: process must survive
+
+
+def test_hang_exits_3_in_subprocess():
+    code = """
+import sys
+sys.path.insert(0, %r)
+import jax  # noqa: F401  (import before patching)
+import time
+import fleetx_tpu.utils.device_guard as dg
+
+def hang():
+    time.sleep(60)
+
+jax.devices = hang
+dg.acquire_devices_or_die(1, label="hangtest")
+""" % REPO
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=30,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 3, (r.returncode, r.stderr[-500:])
+    assert "exceeded 1s" in r.stderr

@@ -1,0 +1,80 @@
+"""LR schedule VALUE semantics (optims/lr_scheduler.py vs the reference
+contracts: warmup slopes, decay endpoints, post-decay floors)."""
+
+import numpy as np
+import pytest
+
+from fleetx_tpu.optims.lr_scheduler import (
+    CosineAnnealingWithWarmupDecay,
+    CosineDecay,
+    LinearDecayWithWarmup,
+    MultiStepDecay,
+    ViTLRScheduler,
+    build_lr_scheduler,
+)
+
+
+def test_cosine_warmup_decay_endpoints():
+    s = CosineAnnealingWithWarmupDecay(max_lr=1e-3, min_lr=1e-5,
+                                       decay_steps=1000, warmup_steps=100)
+    assert float(s(0)) == 0.0
+    np.testing.assert_allclose(float(s(50)), 5e-4, rtol=1e-6)   # mid-warmup
+    np.testing.assert_allclose(float(s(100)), 1e-3, rtol=1e-6)  # peak
+    np.testing.assert_allclose(float(s(550)), (1e-3 + 1e-5) / 2, rtol=1e-5)
+    np.testing.assert_allclose(float(s(1000)), 1e-5, rtol=1e-5)
+    np.testing.assert_allclose(float(s(5000)), 1e-5, rtol=1e-5)  # floor holds
+
+
+def test_cosine_warmup_rate_derives_warmup_steps():
+    s = CosineAnnealingWithWarmupDecay(max_lr=1.0, decay_steps=1000,
+                                       warmup_rate=0.1)
+    np.testing.assert_allclose(float(s(100)), 1.0, rtol=1e-6)
+    assert float(s(99)) < 1.0
+
+
+def test_linear_decay_with_warmup():
+    s = LinearDecayWithWarmup(learning_rate=2e-5, total_steps=1000,
+                              warmup=0.1)
+    np.testing.assert_allclose(float(s(50)), 1e-5, rtol=1e-6)
+    np.testing.assert_allclose(float(s(100)), 2e-5, rtol=1e-6)
+    np.testing.assert_allclose(float(s(550)), 1e-5, rtol=1e-3)
+    assert float(s(1000)) == 0.0
+    # integer warmup means steps, not fraction
+    s2 = LinearDecayWithWarmup(learning_rate=1.0, total_steps=100, warmup=20)
+    np.testing.assert_allclose(float(s2(20)), 1.0, rtol=1e-6)
+
+
+def test_linear_decay_requires_total_steps():
+    with pytest.raises(ValueError, match="total_steps"):
+        LinearDecayWithWarmup(learning_rate=1e-5)
+
+
+def test_vit_scheduler_cosine_and_linear():
+    s = ViTLRScheduler(learning_rate=1e-3, epochs=10, step_each_epoch=100,
+                       warmup_epochs=1)
+    np.testing.assert_allclose(float(s(100)), 1e-3, rtol=1e-6)
+    np.testing.assert_allclose(float(s(1000)), 0.0, atol=1e-9)
+    lin = ViTLRScheduler(learning_rate=1.0, epochs=1, step_each_epoch=100,
+                         decay_type="linear")
+    np.testing.assert_allclose(float(lin(50)), 0.5, rtol=1e-6)
+
+
+def test_multistep_decay():
+    s = MultiStepDecay(learning_rate=0.1, milestones=[30, 60], gamma=0.1)
+    np.testing.assert_allclose(float(s(10)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(s(30)), 0.01, rtol=1e-6)
+    np.testing.assert_allclose(float(s(100)), 0.001, rtol=1e-5)
+
+
+def test_cosine_decay_alpha_floor():
+    s = CosineDecay(learning_rate=1.0, decay_steps=100, alpha=0.1)
+    np.testing.assert_allclose(float(s(0)), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(s(100)), 0.1, rtol=1e-5)
+    np.testing.assert_allclose(float(s(500)), 0.1, rtol=1e-5)
+
+
+def test_builder_constant_and_unknown():
+    s = build_lr_scheduler(3e-4)
+    np.testing.assert_allclose(float(s(123)), 3e-4, rtol=1e-7)
+    with pytest.raises(ValueError, match="unknown lr scheduler"):
+        build_lr_scheduler({"name": "Nope"})
