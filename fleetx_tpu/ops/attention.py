@@ -75,9 +75,10 @@ def causal_attention(
     Routes to the Pallas flash kernel when profitable (TPU, train-time
     shapes, mask expressible as causal and/or right-padding ``kv_lens``);
     falls back to the XLA path for arbitrary ``attn_mask`` tensors or
-    decode shapes. Attention dropout runs inside the kernel (hash-based
-    mask, see fleetx_tpu/ops/pallas/flash_attention.py), so dropout>0
-    training configs stay on the flash path. Both paths produce identical
+    decode shapes. Attention dropout runs inside the kernel (hardware PRNG
+    on real TPUs, counter-hash on the interpreter — see
+    fleetx_tpu/ops/pallas/flash_attention.py), so dropout>0 training
+    configs stay on the flash path. Both paths produce identical
     math in the deterministic case (kernel is tested against this
     reference implementation). Non-causal + kv_lens covers the ERNIE-style
     bidirectional encoder with right-padded batches.
