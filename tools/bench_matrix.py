@@ -120,10 +120,11 @@ def run_case(name, overrides, args, data_prefix, tmp):
     # inherited level (e.g. the test conftest) would blank the log
     env["FLEETX_LOG_LEVEL"] = "INFO"
     # default: virtual CPU mesh (topology/convergence gate, not a perf
-    # number). BENCH_MATRIX_PLATFORM=tpu runs the cases on a real slice
-    # with >= --devices chips (reference test_tipc measures real perf).
-    if args.devices > 1 and os.environ.get(
-            "BENCH_MATRIX_PLATFORM", "cpu") == "cpu":
+    # number) — including the single-device N1C1 case, so the grid never
+    # blocks on a wedged TPU tunnel. BENCH_MATRIX_PLATFORM=tpu runs the
+    # cases on a real slice with >= --devices chips (reference test_tipc
+    # measures real perf; bench.py is the official single-chip number).
+    if os.environ.get("BENCH_MATRIX_PLATFORM", "cpu") == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = (
             env.get("XLA_FLAGS", "")
