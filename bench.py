@@ -260,8 +260,11 @@ def main():
 
     seq = int(os.environ.get("BENCH_SEQ", 1024))
     batch = int(os.environ.get("BENCH_BATCH", 8))
-    steps = int(os.environ.get("BENCH_STEPS", 10))
-    warmup = int(os.environ.get("BENCH_WARMUP", 3))
+    # 20 timed steps: the r4 session saw ~±5% run-to-run spread at 10
+    # (17.4k vs 18.1k tok/s on back-to-back identical configs); doubling
+    # the window costs ~5s against multi-minute compiles
+    steps = int(os.environ.get("BENCH_STEPS", 20))
+    warmup = int(os.environ.get("BENCH_WARMUP", 5))
     # The reference's own large-model configs pick selective recompute
     # (pretrain_gpt_175B_mp8_pp16.yaml recompute_granularity=core_attn);
     # "full" remat costs an extra forward pass per step. no-remat at 345M
