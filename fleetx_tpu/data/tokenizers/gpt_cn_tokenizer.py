@@ -73,8 +73,8 @@ class SentencePieceUnigram:
             if p.type == 2:  # UNKNOWN
                 unk_id, unk_piece = i, p.piece
                 continue
-            if p.type == 3 or p.type == 5:  # CONTROL/UNUSED: id only, never
-                continue                    # segmented from raw text
+            if p.type in (3, 5):  # CONTROL/UNUSED: id only, never
+                continue          # segmented from raw text
             # NORMAL(1) keeps its trained log-prob; USER_DEFINED(4) and
             # BYTE(6) must stay reachable in the Viterbi too — real
             # sentencepiece segments user-defined pieces with their stored

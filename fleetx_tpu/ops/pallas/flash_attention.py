@@ -160,12 +160,8 @@ def dropout_keep_scale(seed, bh, q_pos, k_pos, rate: float):
     return keep.astype(jnp.float32) / (1.0 - rate)
 
 
-# Attention-dropout bit source on real TPUs: the hardware PRNG
-# (pltpu.prng_seed/prng_random_bits), seeded per (seed, batch*head, q-tile,
-# k-tile) so the forward and both backward kernels regenerate identical
-# bits for congruent tiles. FLEETX_FLASH_HW_RNG=0 forces the lowbias32
-# hash everywhere (the interpreter always uses it: pltpu.prng_* has no CPU
-# lowering), which is also what the CPU parity tests validate bit-for-bit.
+# FLEETX_FLASH_HW_RNG=0 forces the lowbias32 hash bit source on real TPUs
+# too (the interpreter always uses it) — see the module docstring
 HW_RNG = _os.environ.get("FLEETX_FLASH_HW_RNG", "1") == "1"
 
 

@@ -18,6 +18,7 @@ from typing import Optional
 
 import jax
 
+from fleetx_tpu.utils.device_guard import honor_platform_env
 from fleetx_tpu.utils.log import logger
 
 __all__ = ["init_dist_env", "set_seed", "root_key", "global_seed", "data_rank_key"]
@@ -42,10 +43,8 @@ def init_dist_env(
     the Mesh carries all topology.
     """
     # Honor an explicit JAX_PLATFORMS request even when a sitecustomize or
-    # other early import already pinned a different platform (the env var is
-    # only read at first backend init, so re-pin through the config system).
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # other early import already pinned a different platform.
+    honor_platform_env()
     coordinator_address = coordinator_address or os.environ.get("FLEETX_COORDINATOR")
     if num_processes is None and os.environ.get("FLEETX_NUM_PROCESSES"):
         num_processes = int(os.environ["FLEETX_NUM_PROCESSES"])

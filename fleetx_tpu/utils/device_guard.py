@@ -14,7 +14,22 @@ from __future__ import annotations
 import os
 import sys
 
-__all__ = ["acquire_devices_or_die"]
+__all__ = ["acquire_devices_or_die", "honor_platform_env"]
+
+
+def honor_platform_env() -> None:
+    """Re-apply a JAX_PLATFORMS request through jax.config.
+
+    The env var is only read at first backend init, and a sitecustomize (the
+    sandbox pins the axon/TPU backend) may re-pin the platform AFTER env
+    vars are read — so subprocesses that must stay off the TPU (converters,
+    CPU test drives) call this before their first device use. The single
+    shared implementation of the pin used by parallel/env.init_dist_env and
+    the CLI tools."""
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
 def acquire_devices_or_die(timeout_s: int = 300, label: str = "fleetx",

@@ -4,21 +4,11 @@ that must stay in lockstep with the models' fused ``qkv_proj`` layout
 ([..., embed, heads, 3*head_dim], q|k|v packed per head along the last
 axis)."""
 
-import os
-
 import numpy as np
 
-
-def honor_platform_env():
-    """Re-apply a caller's JAX_PLATFORMS request via jax.config: the sandbox
-    sitecustomize pins the axon (TPU) backend AFTER env vars are read, so a
-    ``JAX_PLATFORMS=cpu`` subprocess env alone is ignored — and conversion
-    is pure host work that must not block on a wedged TPU tunnel (same
-    pattern as fleetx_tpu/parallel/env.py:44-48)."""
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
-
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+# conversion is pure host work that must not block on a wedged TPU tunnel:
+# the converters call this before first device use (shared implementation)
+from fleetx_tpu.utils.device_guard import honor_platform_env  # noqa: F401
 
 
 def linear_t(sd, name):
