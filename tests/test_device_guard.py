@@ -56,3 +56,22 @@ dg.acquire_devices_or_die(1, label="hangtest")
     )
     assert r.returncode == 3, (r.returncode, r.stderr[-500:])
     assert "exceeded 1s" in r.stderr
+
+
+def test_honor_platform_env_applies_config(monkeypatch):
+    """The shared pin helper re-applies JAX_PLATFORMS through jax.config
+    (sitecustomize pins the platform after env vars are read)."""
+    import jax
+
+    from fleetx_tpu.utils.device_guard import honor_platform_env
+
+    calls = []
+    monkeypatch.setattr(jax.config, "update",
+                        lambda k, v: calls.append((k, v)))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    honor_platform_env()
+    assert calls == [("jax_platforms", "cpu")]
+    calls.clear()
+    monkeypatch.delenv("JAX_PLATFORMS")
+    honor_platform_env()  # unset env: no pin
+    assert calls == []
