@@ -1,5 +1,6 @@
 """The style gate's own tests (reference codestyle/test_docstring_checker.py)."""
 
+import os
 import subprocess
 import sys
 
@@ -54,3 +55,21 @@ def test_repo_tree_is_clean():
         capture_output=True, text=True,
     )
     assert r.returncode == 0, r.stdout[-1500:]
+
+
+def test_shell_scripts_parse():
+    """bash -n over every launch/benchmark script (the reference gates its
+    shell surface through CI runs; we gate syntax statically)."""
+    import glob
+
+    scripts = [
+        p for pat in ("projects/**/*.sh", "benchmarks/**/*.sh", "tools/*.sh")
+        for p in glob.glob(os.path.join(REPO, pat), recursive=True)
+    ]
+    assert len(scripts) >= 40, scripts  # the launch-script zoo is present
+    bad = []
+    for s in scripts:
+        r = subprocess.run(["bash", "-n", s], capture_output=True, text=True)
+        if r.returncode != 0:
+            bad.append((s, r.stderr[:200]))
+    assert not bad, bad
