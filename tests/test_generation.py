@@ -202,3 +202,30 @@ def test_eval_module_scoring(tmp_path):
     }
     result = mod.evaluate_dataset(params["params"], [batch])
     assert "ppl" in result and np.isfinite(result["ppl"]) and result["ppl"] > 1
+
+
+def test_left_padded_prompt_matches_unpadded(model_and_params):
+    """A left-padded prompt row with attention_mask must decode the SAME
+    continuation as the unpadded prompt: pad slots are never attended and
+    position ids shift so the first real token sits at position 0
+    (generation.py pad_counts / kv_valid path)."""
+    model, params = model_and_params
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(1, 97, (1, 4)).astype(np.int32)
+    gen = GenerationConfig(max_length=5, min_length=5,
+                           decode_strategy="greedy",
+                           eos_token_id=10**6, pad_token_id=0)
+
+    out_plain = generate(model, params, jnp.asarray(prompt), gen)
+    cont_plain = np.asarray(out_plain)[0, 4:]
+
+    pad = np.zeros((1, 3), np.int32)
+    padded = np.concatenate([pad, prompt], axis=1)
+    mask = np.concatenate(
+        [np.zeros((1, 3), np.int32), np.ones((1, 4), np.int32)], axis=1
+    )
+    out_padded = generate(model, params, jnp.asarray(padded), gen,
+                          attention_mask=jnp.asarray(mask))
+    cont_padded = np.asarray(out_padded)[0, 7:]
+
+    np.testing.assert_array_equal(cont_plain, cont_padded)
