@@ -193,3 +193,30 @@ def test_generate_dispatches_beam(model_and_params):
     )
     out = generate(model, params, jnp.asarray(prompts), cfg)
     assert out.shape == (4, 7)  # [b*nret, prompt+max]
+
+
+def test_left_padded_prompt_matches_unpadded_beam(model_and_params):
+    """Beam search with a left-padded masked prompt must return the same
+    continuations as the unpadded prompt (beam_search.py's pad handling)."""
+    import numpy as np
+
+    model, params = model_and_params
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(1, V, (1, 4)).astype(np.int32)
+    gen = GenerationConfig(
+        max_length=4, min_length=4, decode_strategy="beam_search",
+        num_beams=3, eos_token_id=10**6, pad_token_id=0, length_penalty=1.0,
+    )
+    plain = np.asarray(beam_search(model, params, jnp.asarray(prompt), gen))
+    cont_plain = plain[0, :, 4:]
+
+    padded = np.concatenate([np.zeros((1, 2), np.int32), prompt], axis=1)
+    mask = np.concatenate(
+        [np.zeros((1, 2), np.int32), np.ones((1, 4), np.int32)], axis=1
+    )
+    out = np.asarray(
+        beam_search(model, params, jnp.asarray(padded), gen,
+                    attention_mask=jnp.asarray(mask))
+    )
+    cont_padded = out[0, :, 6:]
+    np.testing.assert_array_equal(cont_plain, cont_padded)
