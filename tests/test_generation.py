@@ -229,3 +229,34 @@ def test_left_padded_prompt_matches_unpadded(model_and_params):
     cont_padded = np.asarray(out_padded)[0, 7:]
 
     np.testing.assert_array_equal(cont_plain, cont_padded)
+
+
+def test_mixed_padding_batch_rows_independent(model_and_params):
+    """Rows with different left-pad counts in ONE batch must each decode
+    what they decode alone (no cross-row leakage through pad slots)."""
+    model, params = model_and_params
+    rng = np.random.RandomState(9)
+    p1 = rng.randint(1, 97, (1, 5)).astype(np.int32)  # unpadded row
+    p2 = rng.randint(1, 97, (1, 3)).astype(np.int32)  # 2 pads + 3 tokens
+    gen = GenerationConfig(max_length=4, min_length=4,
+                           decode_strategy="greedy",
+                           eos_token_id=10**6, pad_token_id=0)
+
+    solo1 = np.asarray(generate(model, params, jnp.asarray(p1), gen))[0, 5:]
+    mask2 = np.concatenate(
+        [np.zeros((1, 2), np.int32), np.ones((1, 3), np.int32)], axis=1
+    )
+    padded2 = np.concatenate([np.zeros((1, 2), np.int32), p2], axis=1)
+    solo2 = np.asarray(
+        generate(model, params, jnp.asarray(padded2), gen,
+                 attention_mask=jnp.asarray(mask2))
+    )[0, 5:]
+
+    batch = np.concatenate([p1, padded2], axis=0)
+    mask = np.concatenate([np.ones((1, 5), np.int32), mask2], axis=0)
+    both = np.asarray(
+        generate(model, params, jnp.asarray(batch), gen,
+                 attention_mask=jnp.asarray(mask))
+    )
+    np.testing.assert_array_equal(both[0, 5:], solo1)
+    np.testing.assert_array_equal(both[1, 5:], solo2)
