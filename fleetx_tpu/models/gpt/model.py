@@ -214,6 +214,9 @@ class SelfAttention(nn.Module):
             dropout_rng=dropout_rng,
             deterministic=deterministic,
             use_flash=cfg.use_flash_attention and not decode,
+            # pp>1 applies stages under nn.vmap; a nested shard_map there
+            # would fight the stage sharding (parallel/pipeline.py)
+            mesh_shard=cfg.pp_degree == 1,
         )
         out = checkpoint_name(out, "core_attn_out")
         return self._out_proj(out)

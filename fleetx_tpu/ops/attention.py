@@ -69,6 +69,7 @@ def causal_attention(
     dropout_rng: Optional[jax.Array] = None,
     deterministic: bool = True,
     use_flash: bool = True,
+    mesh_shard: bool = True,
 ) -> jax.Array:
     """Multi-head scaled-dot-product attention, [b, s, h, d] layout.
 
@@ -82,6 +83,10 @@ def causal_attention(
     math in the deterministic case (kernel is tested against this
     reference implementation). Non-causal + kv_lens covers the ERNIE-style
     bidirectional encoder with right-padded batches.
+
+    ``mesh_shard=False`` disables the kernel's mesh shard_map wrapper —
+    required on the pp>1 path where attention runs under the pipeline's
+    stage vmap (see flash_attention's docstring).
     """
     effective_dropout = 0.0 if deterministic else dropout_rate
 
@@ -102,13 +107,20 @@ def causal_attention(
         # overhead regardless of size
         return bq is not None and (bq >= 128 or bq == s)
 
+    import os as _os
+
     can_flash = (
         use_flash
         and attn_mask is None
         and (effective_dropout == 0.0 or dropout_rng is not None)
         and q.shape[1] == k.shape[1]  # not incremental decode
         and _tileable(q.shape[1])
-        and jax.default_backend() in ("tpu", "axon")
+        and (
+            jax.default_backend() in ("tpu", "axon")
+            # interpreter-mode kernel on CPU: the multichip dryrun uses this
+            # to execute the flash shard_map composition on the virtual mesh
+            or _os.environ.get("FLEETX_FORCE_FLASH") == "1"
+        )
     )
     if can_flash:
         from fleetx_tpu.ops.pallas.flash_attention import flash_attention
@@ -116,6 +128,7 @@ def causal_attention(
         return flash_attention(
             q, k, v, causal=causal, kv_lens=kv_lens,
             dropout_rate=effective_dropout, dropout_rng=dropout_rng,
+            mesh_shard=mesh_shard,
         )
     if kv_lens is not None:
         key_valid = (

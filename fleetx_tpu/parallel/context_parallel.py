@@ -200,25 +200,11 @@ def ring_attention(
 
 
 def _ambient_mesh() -> Optional[Mesh]:
-    """The mesh this call should shard_map over, best-effort: the modern
-    jax context mesh (jax.sharding.set_mesh) first, then the framework's own
-    registry (fleetx_tpu.parallel.mesh.use_mesh — what the Trainer enters).
-    No deprecated thread_resources lookups."""
-    try:
-        m = jax.sharding.get_mesh()  # set via jax.sharding.set_mesh
-        if m is not None and not m.empty:
-            return m
-    except Exception:
-        pass
-    try:
-        m = jax.sharding.get_abstract_mesh()
-        if m is not None and not m.empty:  # pragma: no cover - version dependent
-            return m
-    except Exception:
-        pass
-    from fleetx_tpu.parallel.mesh import active_mesh
+    """Back-compat alias — the lookup now lives in parallel/mesh.py where
+    the flash kernel's TP wrapper shares it."""
+    from fleetx_tpu.parallel.mesh import ambient_mesh
 
-    return active_mesh()
+    return ambient_mesh()
 
 
 def ring_self_attention(

@@ -34,6 +34,7 @@ __all__ = [
     "mesh_from_config",
     "use_mesh",
     "active_mesh",
+    "ambient_mesh",
     "DATA_AXES",
     "get_data_world",
     "batch_sharding",
@@ -150,3 +151,25 @@ def use_mesh(mesh: Mesh):
             yield mesh
     finally:
         _ACTIVE_MESHES.reset(token)
+
+
+def ambient_mesh() -> Optional[Mesh]:
+    """The mesh a model-interior ``shard_map`` should run over, best-effort:
+    the modern jax context mesh (jax.sharding.set_mesh) first, then the
+    framework's own registry (:func:`use_mesh` — what the Trainer enters).
+    No deprecated thread_resources lookups. Used by ring attention
+    (parallel/context_parallel.py) and the flash kernel's TP wrapper
+    (ops/pallas/flash_attention.py)."""
+    try:
+        m = jax.sharding.get_mesh()  # set via jax.sharding.set_mesh
+        if m is not None and not m.empty:
+            return m
+    except Exception:
+        pass
+    try:
+        m = jax.sharding.get_abstract_mesh()
+        if m is not None and not m.empty:  # pragma: no cover - version dependent
+            return m
+    except Exception:
+        pass
+    return active_mesh()

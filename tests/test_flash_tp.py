@@ -1,0 +1,143 @@
+"""Flash kernel × tensor/data parallel composition (VERDICT r4 weak #3).
+
+The Pallas call is opaque to GSPMD: without an explicit shard_map, a mesh
+with mp>1 would all-gather the heads dim of q/k/v right around the kernel —
+correct math, TP-destroying layout. These tests pin the composition:
+
+- numerics: the mesh-wrapped kernel (shard_map over batch->dp/fsdp,
+  heads->mp) produces bit-identical outputs to the unsharded call, with
+  dropout ON (the bit stream is keyed on global coordinates via the
+  kernel's ``meta`` input, so sharding cannot move the mask);
+- gradients: custom-VJP kernels run under the same shard_map;
+- lowering: the TPU StableHLO contains the Mosaic custom call at the
+  LOCAL (per-shard) shape — proof the kernel runs on shards, no gather.
+
+Reference anchor: column-parallel qkv implies heads-sharded core_attn
+(/root/reference/ppfleetx/models/language_model/gpt/dygraph/
+hybrid_model.py:131-174).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fleetx_tpu.ops.pallas.flash_attention import flash_attention
+from fleetx_tpu.parallel.mesh import MeshConfig, build_mesh, use_mesh
+
+
+def _qkv(b=2, s=256, h=4, d=64, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (b, s, h, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+def _mesh(eight_devices, dp=1, fsdp=1, mp=1):
+    return build_mesh(MeshConfig(dp=dp, fsdp=fsdp, mp=mp), eight_devices)
+
+
+@pytest.mark.parametrize("degrees", [dict(mp=2), dict(dp=2, mp=2),
+                                     dict(dp=2, fsdp=2, mp=2)])
+def test_mesh_forward_bitwise_matches_unsharded(eight_devices, degrees):
+    q, k, v = _qkv()
+    ref = flash_attention(q, k, v, mesh_shard=False)
+    with use_mesh(_mesh(eight_devices, **degrees)):
+        out = flash_attention(q, k, v)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_mesh_dropout_mask_is_layout_invariant(eight_devices):
+    """Same rng => same realized dropout mask at mp2dp2 as unsharded: the
+    hash is keyed on (global bh, global positions), not grid-local ids."""
+    q, k, v = _qkv()
+    rng = jax.random.PRNGKey(7)
+    ref = flash_attention(q, k, v, dropout_rate=0.2, dropout_rng=rng,
+                          mesh_shard=False)
+    with use_mesh(_mesh(eight_devices, dp=2, mp=2)):
+        out = flash_attention(q, k, v, dropout_rate=0.2, dropout_rng=rng)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_mesh_grads_match_unsharded(eight_devices):
+    q, k, v = _qkv(d=32)
+    rng = jax.random.PRNGKey(3)
+
+    def loss(q, k, v):
+        return (flash_attention(q, k, v, dropout_rate=0.1,
+                                dropout_rng=rng) ** 2).sum()
+
+    gr = jax.grad(lambda a, b, c: (flash_attention(
+        a, b, c, dropout_rate=0.1, dropout_rng=rng,
+        mesh_shard=False) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    with use_mesh(_mesh(eight_devices, mp=2)):
+        gm = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gm, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_mesh_kv_lens_matches_unsharded(eight_devices):
+    """ERNIE-style right-padded encoder path: kv_lens shards over the data
+    axes with its batch."""
+    q, k, v = _qkv(b=4)
+    kv_lens = jnp.asarray([100, 256, 17, 200], jnp.int32)
+    ref = flash_attention(q, k, v, causal=False, kv_lens=kv_lens,
+                          mesh_shard=False)
+    with use_mesh(_mesh(eight_devices, dp=2, mp=2)):
+        out = flash_attention(q, k, v, causal=False, kv_lens=kv_lens)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_mesh_indivisible_heads_falls_back(eight_devices):
+    """h=3 doesn't divide mp=2: the wrapper must decline, not crash."""
+    q, k, v = _qkv(h=3)
+    ref = flash_attention(q, k, v, mesh_shard=False)
+    with use_mesh(_mesh(eight_devices, mp=2)):
+        out = flash_attention(q, k, v)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_mp2_lowering_keeps_kernel_local_shapes(eight_devices):
+    """AOT-lower an mp2(+dp2) fwd+bwd for TPU and assert the Mosaic custom
+    call operates on the PER-SHARD shape — i.e. GSPMD did not replicate
+    q/k/v around the kernel (the all-gather failure mode)."""
+    import fleetx_tpu.ops.pallas.flash_attention as fa
+
+    b, s, h, d = 4, 256, 8, 64
+    q = jnp.zeros((b, s, h, d), jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+
+    def fwd(q, k, v):
+        return fa.flash_attention(q, k, v, dropout_rate=0.1, dropout_rng=rng)
+
+    def bwd(q, k, v):
+        return jax.grad(
+            lambda a, b_, c: fwd(a, b_, c).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+
+    orig = fa._interpret
+    fa._interpret = lambda: False
+    try:
+        with use_mesh(_mesh(eight_devices, dp=2, mp=2)):
+            texts = [
+                jax.jit(fn).trace(q, q, q)
+                .lower(lowering_platforms=("tpu",)).as_text()
+                for fn in (fwd, bwd)
+            ]
+    finally:
+        fa._interpret = orig
+
+    # global flattened batch*heads = 32; per-shard (dp2 x mp2) = 8
+    local = f"tensor<8x{s}x{d}xbf16>"
+    global_ = f"tensor<{b * h}x{s}x{d}xbf16>"
+    for text in texts:
+        assert "tpu_custom_call" in text
+        call_lines = [ln for ln in text.splitlines() if "tpu_custom_call" in ln]
+        assert any(local in ln for ln in call_lines), (
+            "kernel not lowered at the per-shard shape:\n" + call_lines[0]
+        )
+        assert not any(global_ in ln for ln in call_lines), (
+            "kernel saw the GLOBAL shape — GSPMD replicated the operands"
+        )
