@@ -184,19 +184,22 @@ class SelfAttention(nn.Module):
         if cfg.cp_degree > 1 and not decode:
             # Ring attention: sequence stays sharded over the cp axis; KV
             # blocks rotate with ppermute (parallel/context_parallel.py).
+            # Attention dropout runs inside the per-hop flash kernels and is
+            # keyed on global positions — the mask matches the non-cp path.
             if attn_mask is not None:
                 raise NotImplementedError(
                     "context parallelism does not support a custom attn_mask"
                 )
-            if cfg.attention_probs_dropout_prob > 0.0 and not deterministic:
-                raise NotImplementedError(
-                    "context parallelism requires attention_probs_dropout_prob=0 "
-                    "(hidden dropout is unaffected)"
-                )
             from fleetx_tpu.parallel.context_parallel import ring_self_attention
 
+            cp_dropout_rng = None
+            if cfg.attention_probs_dropout_prob > 0.0 and not deterministic:
+                cp_dropout_rng = self.make_rng("dropout")
             out = ring_self_attention(
-                q, k, v, causal=causal, expected_cp=cfg.cp_degree
+                q, k, v, causal=causal, expected_cp=cfg.cp_degree,
+                dropout_rate=(0.0 if deterministic
+                              else cfg.attention_probs_dropout_prob),
+                dropout_rng=cp_dropout_rng,
             )
             out = checkpoint_name(out, "core_attn_out")
             return self._out_proj(out)

@@ -165,15 +165,22 @@ def dropout_keep_scale(seed, bh, q_pos, k_pos, rate: float):
 HW_RNG = _os.environ.get("FLEETX_FLASH_HW_RNG", "1") == "1"
 
 
-def _tile_keep_scale(seed, bh, qb, kb, q_col, k_row, shape, rate: float):
+def _tile_keep_scale(seed, bh, qb, kb, q_col, k_row, shape, rate: float,
+                     hw_rng: bool = True):
     """Dropout keep/scale for one [block_q, block_k] score tile.
 
     seed/bh: int32 scalars; qb/kb: GLOBAL tile indices (int32, traced);
     q_col/k_row: [bq, 1] / [1, bk] global positions for the hash fallback.
     All three kernels tile scores congruently ([block_q, block_k], q rows x
     k cols), so (qb, kb) identifies the same cells everywhere.
+
+    ``hw_rng=False`` forces the position-keyed hash even on real TPUs: the
+    HW PRNG stream is keyed on TILE ids and tile-shaped draws, so it is
+    only reproducible between kernels that tile identically — ring-CP pair
+    calls (fit to s_blk, not s) must use the hash to keep the realized
+    mask equal to the unsharded kernel's for every cp layout.
     """
-    if HW_RNG and not _interpret():
+    if hw_rng and HW_RNG and not _interpret():
         pltpu.prng_seed(seed, bh, qb, kb)
         bits = pltpu.prng_random_bits(shape)
         bits = jax.lax.bitcast_convert_type(bits, jnp.int32)
@@ -263,7 +270,7 @@ def _global_ids(meta_ref, bh):
 def _fwd_kernel(seed_ref, kvlens_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
                 lse_ref, m_scr, l_scr, acc_scr, *, block_k: int, major: int,
                 scale: float, dropout_rate: float, causal: bool,
-                n_major: int):
+                n_major: int, hw_rng: bool = True):
     """Grid step (bh, q-block i, K/V major block jm): online-softmax updates
     over the compute tiles inside the resident major block."""
     bq, d = q_ref.shape
@@ -321,7 +328,7 @@ def _fwd_kernel(seed_ref, kvlens_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
                 p = p * _tile_keep_scale(
                     seed_ref[0], gbh, q_off // bq + i,
                     k_off // block_k + jm * tiles + t, q_col, k_row,
-                    (bq, block_k), dropout_rate,
+                    (bq, block_k), dropout_rate, hw_rng,
                 )
             acc_new = alpha * acc + jax.lax.dot_general(
                 p.astype(mm_dt), v_blk, (((1,), (0,)), ((), ())),
@@ -373,7 +380,8 @@ def _fwd_kernel(seed_ref, kvlens_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
 def _bwd_dq_kernel(seed_ref, kvlens_ref, meta_ref, q_ref, k_ref, v_ref,
                    do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *,
                    block_k: int, major: int, scale: float,
-                   dropout_rate: float, causal: bool, n_major: int):
+                   dropout_rate: float, causal: bool, n_major: int,
+                   hw_rng: bool = True):
     bq, d = q_ref.shape
     bh = pl.program_id(0)
     i = pl.program_id(1)
@@ -420,7 +428,7 @@ def _bwd_dq_kernel(seed_ref, kvlens_ref, meta_ref, q_ref, k_ref, v_ref,
                 dp = dp * _tile_keep_scale(
                     seed_ref[0], gbh, q_off // bq + i,
                     k_off // block_k + jm * tiles + t, q_col, k_row,
-                    (bq, block_k), dropout_rate,
+                    (bq, block_k), dropout_rate, hw_rng,
                 )
             ds = p * (dp - delta)
             return dq + jax.lax.dot_general(
@@ -477,7 +485,8 @@ def _q_stream_index_map(block_k: int, major: int, causal: bool):
 def _bwd_dkv_kernel(seed_ref, kvlens_ref, meta_ref, q_ref, k_ref, v_ref,
                     do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr,
                     dv_scr, *, block_q: int, major: int, scale: float,
-                    dropout_rate: float, causal: bool, n_major: int):
+                    dropout_rate: float, causal: bool, n_major: int,
+                    hw_rng: bool = True):
     bk, d = k_ref.shape
     bh = pl.program_id(0)
     j = pl.program_id(1)
@@ -524,7 +533,7 @@ def _bwd_dkv_kernel(seed_ref, kvlens_ref, meta_ref, q_ref, k_ref, v_ref,
                 drop = _tile_keep_scale(
                     seed_ref[0], gbh, q_off // block_q + im * tiles + t,
                     k_off // bk + j, q_col, k_row,
-                    (block_q, bk), dropout_rate,
+                    (block_q, bk), dropout_rate, hw_rng,
                 )
                 p_v = p * drop  # dropped probabilities feed dV
                 dp = dp * drop
@@ -593,7 +602,7 @@ def _seed_spec():
 
 
 def _fwd_call(seed, kvlens, meta, q3, k3, v3, block_q, block_k, scale,
-              dropout_rate, causal):
+              dropout_rate, causal, hw_rng=True):
     bh, s, d = q3.shape
     major = _major_block(s, block_k, DEFAULT_BLOCK_MAJOR)
     n_major = s // major
@@ -601,6 +610,7 @@ def _fwd_call(seed, kvlens, meta, q3, k3, v3, block_q, block_k, scale,
     kernel = functools.partial(
         _fwd_kernel, block_k=block_k, major=major, scale=scale,
         dropout_rate=dropout_rate, causal=causal, n_major=n_major,
+        hw_rng=hw_rng,
     )
     kv_map = _kv_index_map(block_q, major, causal, n_major)
     return pl.pallas_call(
@@ -654,21 +664,18 @@ def _flash_fwd(q, k, v, seed, kvlens, meta, block_q, block_k, dropout_rate,
     return _from_bh(o3, b, h), (q3, k3, v3, o3, lse, seed, kvlens, meta, b, h)
 
 
-def _flash_bwd(block_q, block_k, dropout_rate, causal, res, g):
-    q3, k3, v3, o3, lse, seed, kvlens, meta, b, h = res
+def _dq_call(seed, kvlens, meta, q3, k3, v3, do3, lse, delta, block_q,
+             block_k, scale, dropout_rate, causal, hw_rng=True):
+    """dq kernel dispatch ([bh, s, d] operands; lse/delta [bh, s, 1])."""
     bh, s, d = q3.shape
-    scale = 1.0 / (d**0.5)
-    do3 = _to_bh(g)
-    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1,
-                    keepdims=True)  # [bh, s, 1]
-
     kv_major = _major_block(s, block_k, DEFAULT_BLOCK_MAJOR)
     n_kv_major = s // kv_major
     kv_map = _kv_index_map(block_q, kv_major, causal, n_kv_major)
-    dq3 = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, block_k=block_k, major=kv_major, scale=scale,
             dropout_rate=dropout_rate, causal=causal, n_major=n_kv_major,
+            hw_rng=hw_rng,
         ),
         grid=(bh, s // block_q, n_kv_major),
         in_specs=[
@@ -690,13 +697,19 @@ def _flash_bwd(block_q, block_k, dropout_rate, causal, res, g):
         interpret=_interpret(),
     )(seed, kvlens, meta, q3, k3, v3, do3, lse, delta)
 
+
+def _dkv_call(seed, kvlens, meta, q3, k3, v3, do3, lse, delta, block_q,
+              block_k, scale, dropout_rate, causal, hw_rng=True):
+    """dk/dv kernel dispatch ([bh, s, d] operands; lse/delta [bh, s, 1])."""
+    bh, s, d = q3.shape
     q_major = _major_block(s, block_q, DEFAULT_BLOCK_MAJOR)
     n_q_major = s // q_major
     q_map = _q_stream_index_map(block_k, q_major, causal)
-    dk3, dv3 = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, block_q=block_q, major=q_major, scale=scale,
             dropout_rate=dropout_rate, causal=causal, n_major=n_q_major,
+            hw_rng=hw_rng,
         ),
         grid=(bh, s // block_k, n_q_major),
         in_specs=[
@@ -726,6 +739,19 @@ def _flash_bwd(block_q, block_k, dropout_rate, causal, res, g):
         interpret=_interpret(),
     )(seed, kvlens, meta, q3, k3, v3, do3, lse, delta)
 
+
+def _flash_bwd(block_q, block_k, dropout_rate, causal, res, g):
+    q3, k3, v3, o3, lse, seed, kvlens, meta, b, h = res
+    bh, s, d = q3.shape
+    scale = 1.0 / (d**0.5)
+    do3 = _to_bh(g)
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # [bh, s, 1]
+    dq3 = _dq_call(seed, kvlens, meta, q3, k3, v3, do3, lse, delta,
+                   block_q, block_k, scale, dropout_rate, causal)
+    dk3, dv3 = _dkv_call(seed, kvlens, meta, q3, k3, v3, do3, lse, delta,
+                         block_q, block_k, scale, dropout_rate, causal)
+
     dq = _from_bh(dq3, b, h)
     dk = _from_bh(dk3, b, h)
     dv = _from_bh(dv3, b, h)
@@ -737,6 +763,83 @@ def _flash_bwd(block_q, block_k, dropout_rate, causal, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ------------------------------------------------- ring-CP building blocks
+# Per-(q-block, kv-block) kernel entry points for ring attention
+# (parallel/context_parallel.py): [b, s, h, d] operands, explicit global
+# position offsets via ``meta``, and the log-sum-exp exposed so hops can be
+# merged in (out, lse) space. The ring owns its own custom VJP (re-rotating
+# KV), so these are raw primal/cotangent dispatches, not custom_vjp'd.
+#
+# Offset rule: ``causal=True`` requires meta's q_off == k_off (the DMA
+# index-map diagonal clamp assumes an aligned diagonal — exactly the ring's
+# same-block-id case); cross-block pairs are fully ordered and call with
+# causal=False.
+
+def _ring_blocks(s: int):
+    bq, bk = fit_blocks(s, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+    if bq is None:
+        raise ValueError(f"ring block seq {s} not tileable (multiple of 8)")
+    return bq, bk
+
+
+def _lse_to_bsh(lse3, b, h):
+    """[b*h, s, 1] f32 -> [b, s, h]"""
+    bh, s, _ = lse3.shape
+    return lse3[..., 0].reshape(b, h, s).transpose(0, 2, 1)
+
+
+def _lse_from_bsh(lse, b, h):
+    """[b, s, h] f32 -> [b*h, s, 1]"""
+    s = lse.shape[1]
+    return lse.transpose(0, 2, 1).reshape(b * h, s, 1)
+
+
+def block_fwd_lse(q, k, v, seed, meta, *, causal, dropout_rate, kv_len):
+    """Flash forward on one (q-block, kv-block) pair.
+
+    Returns (out [b, s, h, d], lse [b, s, h] f32). ``kv_len`` is the GLOBAL
+    total key length (keys are masked at k_pos >= kv_len; pass the full
+    sequence length when there is no padding)."""
+    b, s, h, d = q.shape
+    block_q, block_k = _ring_blocks(s)
+    kvlens = jnp.full((b * h,), kv_len, jnp.int32)
+    o3, lse3 = _fwd_call(
+        seed, kvlens, meta, _to_bh(q), _to_bh(k), _to_bh(v), block_q,
+        block_k, 1.0 / (d**0.5), dropout_rate, causal, hw_rng=False,
+    )
+    return _from_bh(o3, b, h), _lse_to_bsh(lse3, b, h)
+
+
+def block_dq(q, k, v, do, lse, delta, seed, meta, *, causal, dropout_rate,
+             kv_len):
+    """dq of one pair given the MERGED lse/delta ([b, s, h] f32) of the q
+    rows — the flash-attention identity lets each hop's dq be computed
+    against the global softmax statistics."""
+    b, s, h, d = q.shape
+    block_q, block_k = _ring_blocks(s)
+    kvlens = jnp.full((b * h,), kv_len, jnp.int32)
+    dq3 = _dq_call(
+        seed, kvlens, meta, _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(do),
+        _lse_from_bsh(lse, b, h), _lse_from_bsh(delta, b, h), block_q,
+        block_k, 1.0 / (d**0.5), dropout_rate, causal, hw_rng=False,
+    )
+    return _from_bh(dq3, b, h)
+
+
+def block_dkv(q, k, v, do, lse, delta, seed, meta, *, causal, dropout_rate,
+              kv_len):
+    """(dk, dv) of one pair given merged lse/delta of the q rows."""
+    b, s, h, d = q.shape
+    block_q, block_k = _ring_blocks(s)
+    kvlens = jnp.full((b * h,), kv_len, jnp.int32)
+    dk3, dv3 = _dkv_call(
+        seed, kvlens, meta, _to_bh(q), _to_bh(k), _to_bh(v), _to_bh(do),
+        _lse_from_bsh(lse, b, h), _lse_from_bsh(delta, b, h), block_q,
+        block_k, 1.0 / (d**0.5), dropout_rate, causal, hw_rng=False,
+    )
+    return _from_bh(dk3, b, h), _from_bh(dv3, b, h)
 
 
 def _identity_meta(h: int) -> jax.Array:
