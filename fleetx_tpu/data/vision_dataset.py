@@ -63,6 +63,137 @@ def _center_crop(img, out_size):
     return scaled
 
 
+# ---------------------------------------------------------------- MoCo augs
+# The contrastive recipe the reference builds from PIL/paddle.vision ops
+# (/root/reference/ppfleetx/data/transforms/preprocess.py:294-401:
+# ColorJitter, RandomGrayscale, GaussianBlur, RandomErasing), re-implemented
+# as pure-numpy deterministic transforms: every draw comes from the caller's
+# per-(seed, epoch, index) RandomState, so views are reproducible with no
+# PIL dependency. Images are float32 [H, W, 3] in [0, 1] throughout.
+
+_GRAY_W = np.array([0.299, 0.587, 0.114], np.float32)  # ITU-R 601 (PIL 'L')
+
+
+def _rgb_to_hsv(img):
+    r, g, b = img[..., 0], img[..., 1], img[..., 2]
+    maxc = img.max(-1)
+    minc = img.min(-1)
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(delta, 1e-12)
+    rc, gc, bc = (maxc - r) / dz, (maxc - g) / dz, (maxc - b) / dz
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(delta > 0, (h / 6.0) % 1.0, 0.0)
+    return h, s, maxc
+
+
+def _hsv_to_rgb(h, s, v):
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    conds = [i == k for k in range(6)]
+    r = np.select(conds, [v, q, p, p, t, v])
+    g = np.select(conds, [t, v, v, q, p, p])
+    b = np.select(conds, [p, p, t, v, v, q])
+    return np.stack([r, g, b], axis=-1).astype(np.float32)
+
+
+def _blend(a, b, factor):
+    return np.clip(factor * a + (1.0 - factor) * b, 0.0, 1.0).astype(np.float32)
+
+
+def _grayscale(img):
+    g = img @ _GRAY_W
+    return np.repeat(g[..., None], 3, axis=-1)
+
+
+def _color_jitter(rng, img, brightness, contrast, saturation, hue):
+    """torchvision-semantics jitter: factors uniform around 1 (hue additive
+    in cycles), the four adjustments applied in a random order."""
+    ops = []
+    # NB: factors are captured as default args — a bare closure over the
+    # loop variable would late-bind every op to the LAST drawn factor
+    if brightness > 0:
+        f = rng.uniform(max(0.0, 1 - brightness), 1 + brightness)
+        ops.append(lambda im, f=f: _blend(im, np.zeros_like(im), f))
+    if contrast > 0:
+        f = rng.uniform(max(0.0, 1 - contrast), 1 + contrast)
+        ops.append(lambda im, f=f: _blend(im, _grayscale(im).mean(), f))
+    if saturation > 0:
+        f = rng.uniform(max(0.0, 1 - saturation), 1 + saturation)
+        ops.append(lambda im, f=f: _blend(im, _grayscale(im), f))
+    if hue > 0:
+        shift = rng.uniform(-hue, hue)
+
+        def hue_op(im, shift=shift):
+            h, s, v = _rgb_to_hsv(im)
+            return _hsv_to_rgb((h + shift) % 1.0, s, v)
+
+        ops.append(hue_op)
+    for idx in rng.permutation(len(ops)):
+        img = ops[idx](img)
+    return img
+
+
+def _gaussian_blur(img, sigma):
+    """Separable gaussian, reflect padding (SimCLR-style blur; PIL radius
+    == sigma)."""
+    radius = max(1, int(round(3.0 * sigma)))
+    x = np.arange(-radius, radius + 1, dtype=np.float32)
+    kern = np.exp(-0.5 * (x / sigma) ** 2)
+    kern /= kern.sum()
+    for axis in (0, 1):
+        pad = [(0, 0)] * img.ndim
+        pad[axis] = (radius, radius)
+        padded = np.pad(img, pad, mode="reflect")
+        out = np.zeros_like(img)
+        for t, w in enumerate(kern):  # ~2*3σ+1 taps; vectorized over H*W*3
+            sl = [slice(None)] * img.ndim
+            sl[axis] = slice(t, t + img.shape[axis])
+            out += w * padded[tuple(sl)]
+        img = out
+    return img
+
+
+def _random_erasing(rng, img, p=0.5, sl=0.02, sh=0.4, r1=0.3, value=0.0,
+                    attempts=100):
+    """Zero (or fill) a random rectangle (reference RandomErasing,
+    preprocess.py:350, 'const' mode). Mutates and returns ``img``."""
+    if rng.rand() > p:
+        return img
+    h, w = img.shape[:2]
+    area = h * w
+    for _ in range(attempts):
+        target = rng.uniform(sl, sh) * area
+        ar = rng.uniform(r1, 1.0 / r1)
+        eh = int(round(np.sqrt(target * ar)))
+        ew = int(round(np.sqrt(target / ar)))
+        if eh < h and ew < w:
+            y = rng.randint(0, h - eh + 1)
+            x = rng.randint(0, w - ew + 1)
+            img[y : y + eh, x : x + ew] = value
+            return img
+    return img
+
+
+# (jitter args, jitter p, grayscale p, blur p, jitter-before-grayscale,
+#  norm mean/std) per reference config:
+# mocov2_pt_in1k_1n8c.yaml:87-95 — jitter(.4,.4,.4,.1)@p.8 -> gray@.2 ->
+#   blur[.1,2]@.5, imagenet norm;
+# mocov1_pt_in1k_1n8c.yaml:79-81 — gray@.2 -> jitter(.4,.4,.4,.4)@1.0,
+#   no blur, 0.5/0.5 norm.
+_MOCO_RECIPES = {
+    "mocov2": ((0.4, 0.4, 0.4, 0.1), 0.8, 0.2, 0.5, True,
+               _IMAGENET_MEAN, _IMAGENET_STD),
+    "mocov1": ((0.4, 0.4, 0.4, 0.4), 1.0, 0.2, 0.0, False,
+               np.full(3, 0.5, np.float32), np.full(3, 0.5, np.float32)),
+}
+
+
 class GeneralClsDataset:
     """Classification dataset over mmap .npz images with numpy augmentations
     (reference vision_dataset.py)."""
@@ -74,6 +205,7 @@ class GeneralClsDataset:
         seed: int = 1234,
         num_samples: Optional[int] = None,
         normalize: bool = True,
+        random_erasing: float = 0.0,
         **_unused,
     ):
         prefix = input_dir
@@ -102,6 +234,7 @@ class GeneralClsDataset:
         self.seed = seed
         self.epoch = 0
         self.normalize = normalize
+        self.random_erasing = random_erasing
         self._num_samples = num_samples or len(self.labels)
         logger.info(
             "GeneralClsDataset[%s]: %d images (%s), size %d",
@@ -128,6 +261,11 @@ class GeneralClsDataset:
             img = _center_crop(img, self.image_size)
         if self.normalize:
             img = (img - _IMAGENET_MEAN) / _IMAGENET_STD
+        if self.mode == "Train" and self.random_erasing > 0:
+            # post-normalize const erase (timm convention; reference
+            # RandomErasing 'const' mode)
+            img = _random_erasing(rng, np.ascontiguousarray(img),
+                                  p=self.random_erasing)
         return {
             "images": np.ascontiguousarray(img, np.float32),
             "labels": np.int64(self.labels[i]),
@@ -135,17 +273,41 @@ class GeneralClsDataset:
 
 
 class ContrastiveViewsDataset:
-    """Two independently-augmented views per image for MoCo-style training
-    (reference moco dataset transforms: two random crops + flips). Wraps the
-    same storage as GeneralClsDataset; ``synthetic: True`` generates noise
-    images for benchmarking."""
+    """Two independently-augmented views per image for MoCo-style training.
+
+    The augmentation stack is the reference's contrastive recipe
+    (/root/reference/ppfleetx/configs/vis/moco/mocov2_pt_in1k_1n8c.yaml:
+    87-95): random-resized-crop (scale 0.2-1.0) -> ColorJitter ->
+    RandomGrayscale -> GaussianBlur -> horizontal flip -> normalize, with
+    ``recipe: mocov1`` switching to the v1 ordering/strengths (grayscale
+    before full-strength jitter, no blur, 0.5/0.5 normalization). Every
+    knob is individually overridable from YAML. Wraps the same storage as
+    GeneralClsDataset; ``synthetic: True`` generates noise images for
+    benchmarking."""
 
     def __init__(self, input_dir=None, image_size=224, mode="Train", seed=1234,
-                 num_samples=None, synthetic=False, num_synthetic=1280, **_unused):
+                 num_samples=None, synthetic=False, num_synthetic=1280,
+                 recipe="mocov2", crop_scale=(0.2, 1.0), color_jitter=None,
+                 color_jitter_p=None, grayscale_p=None, blur_p=None,
+                 blur_sigma=(0.1, 2.0), **_unused):
         self.image_size = image_size
         self.seed = seed
         self.epoch = 0
         self.mode = mode
+        if recipe not in _MOCO_RECIPES:
+            raise ValueError(
+                f"unknown contrastive recipe {recipe!r}; "
+                f"have {sorted(_MOCO_RECIPES)}"
+            )
+        (jit, jit_p, gray_p, blp, jit_first, mean, std) = _MOCO_RECIPES[recipe]
+        self.color_jitter = tuple(color_jitter) if color_jitter is not None else jit
+        self.color_jitter_p = color_jitter_p if color_jitter_p is not None else jit_p
+        self.grayscale_p = grayscale_p if grayscale_p is not None else gray_p
+        self.blur_p = blur_p if blur_p is not None else blp
+        self.jitter_before_grayscale = jit_first
+        self.norm_mean, self.norm_std = mean, std
+        self.crop_scale = tuple(crop_scale)
+        self.blur_sigma = tuple(blur_sigma)
         self.synthetic = synthetic or input_dir is None
         if self.synthetic:
             self._num_samples = num_samples or num_synthetic
@@ -165,10 +327,26 @@ class ContrastiveViewsDataset:
         return self._num_samples
 
     def _view(self, rng, img):
-        out = _random_resized_crop(rng, img, self.image_size)
+        out = _random_resized_crop(rng, img, self.image_size,
+                                   scale=self.crop_scale)
+
+        def jitter(im):
+            if any(self.color_jitter) and rng.rand() < self.color_jitter_p:
+                im = _color_jitter(rng, im, *self.color_jitter)
+            return im
+
+        def gray(im):
+            if rng.rand() < self.grayscale_p:
+                im = _grayscale(im)
+            return im
+
+        out = gray(jitter(out)) if self.jitter_before_grayscale \
+            else jitter(gray(out))
+        if self.blur_p > 0 and rng.rand() < self.blur_p:
+            out = _gaussian_blur(out, rng.uniform(*self.blur_sigma))
         if rng.rand() < 0.5:
             out = out[:, ::-1]
-        return ((out - _IMAGENET_MEAN) / _IMAGENET_STD).astype(np.float32)
+        return ((out - self.norm_mean) / self.norm_std).astype(np.float32)
 
     def __getitem__(self, index):
         # eval mode: epoch-independent rng so view pairs (and hence the
