@@ -42,17 +42,37 @@ def _flat_parent(parent: jax.Array, nb: int) -> jax.Array:
     return (jnp.arange(b, dtype=jnp.int32)[:, None] * nb + parent).reshape(-1)
 
 
-def _gather_beams(tree, parent: jax.Array, nb: int, batch_axes):
+def _gather_beams(tree, parent: jax.Array, nb: int, batch_axes,
+                  cache_len: int = 0, suffix_start: int = 0):
     """Reindex the beam dimension of every leaf along its batch axis.
     ``batch_axes`` mirrors ``tree`` with the per-leaf batch-axis index (None
     for beam-invariant leaves like scan cache_index scalars) — cache leaves
     under nn.scan carry a leading layer axis, so the batch axis is NOT
-    always 0 and is detected by the caller from shape diffs."""
+    always 0 and is detected by the caller from shape diffs.
+
+    ``suffix_start`` > 0 limits the reorder of kv leaves (position dim ==
+    ``cache_len``, right after the batch dim) to positions >=
+    ``suffix_start``: the prompt region of the cache is IDENTICAL across
+    the beams of a batch row (prefill runs once per row and parents stay
+    within the row), so physically reordering it is pure wasted HBM
+    traffic — the dominant per-step cost at small decode spans. The
+    dynamic_update_slice writes back in place on the donated while-loop
+    carry."""
     flat = _flat_parent(parent, nb)
 
     def one(x, axis):
         if axis is None:
             return x
+        pos_axis = axis + 1
+        if (suffix_start > 0 and x.ndim > pos_axis
+                and x.shape[pos_axis] == cache_len):
+            start = (0,) * pos_axis + (suffix_start,) \
+                + (0,) * (x.ndim - pos_axis - 1)
+            sizes = list(x.shape)
+            sizes[pos_axis] = cache_len - suffix_start
+            suffix = jax.lax.dynamic_slice(x, start, sizes)
+            suffix = jnp.take(suffix, flat, axis=axis)
+            return jax.lax.dynamic_update_slice(x, suffix, start)
         return jnp.take(x, flat, axis=axis)
 
     return jax.tree.map(one, tree, batch_axes)
@@ -91,6 +111,9 @@ def beam_search(
             f"prompt_len({prompt_len}) + max_length({gen_cfg.max_length}) "
             f"exceeds max_position_embeddings({max_pos})"
         )
+    from fleetx_tpu.models.gpt.generation import right_size_decode_cache
+
+    model, cache_len = right_size_decode_cache(model, total_len)
     params = variables["params"] if "params" in variables else variables
     eos = gen_cfg.eos_token_id
     pad = gen_cfg.pad_token_id
@@ -102,7 +125,7 @@ def beam_search(
     am_f = jnp.repeat(attention_mask, nb, axis=0)  # [b*nb, prompt]
     pad_counts = prompt_len - am_f.sum(axis=1)
     kv_valid = jnp.concatenate(
-        [am_f.astype(bool), jnp.ones((b * nb, max_pos - prompt_len), bool)],
+        [am_f.astype(bool), jnp.ones((b * nb, cache_len - prompt_len), bool)],
         axis=1,
     )
     kv_mask = kv_valid[:, None, None, :]
@@ -257,7 +280,8 @@ def beam_search(
         new_tokens = jnp.take(tokens, _flat_parent(parent_all, nb), axis=0)
         new_tokens = jax.lax.dynamic_update_slice(
             new_tokens, tok_all.reshape(b * nb, 1), (0, i))
-        cache = _gather_beams(cache, parent_all, nb, cache_batch_axes)
+        cache = _gather_beams(cache, parent_all, nb, cache_batch_axes,
+                              cache_len=cache_len, suffix_start=prompt_len)
         return new_tokens, cache, new_live, fin_tokens, fin_scores
 
     # first decode position consumes the prefill logits

@@ -95,6 +95,27 @@ def process_logits(logits, tokens, cur_len, cfg: GenerationConfig, *,
     return logits
 
 
+def right_size_decode_cache(model, total_len: int):
+    """(model, cache_len) with the kv cache sized to the decode span.
+
+    Attention streams the whole cache every step, so a 1024-position cache
+    for a 256-token decode would 4x the per-step HBM traffic; unless the
+    caller preset ``decode_cache_len``, clone the model with the cache
+    capped at ``total_len``. A preset that cannot hold the decode raises —
+    an undersized cache would silently clamp writes to the last slot and
+    corrupt the output."""
+    if model.cfg.decode_cache_len is None:
+        model = model.clone(
+            cfg=dataclasses.replace(model.cfg, decode_cache_len=total_len))
+    cache_len = model.cfg.decode_cache_len
+    if cache_len < total_len:
+        raise ValueError(
+            f"decode_cache_len({cache_len}) cannot hold prompt_len + "
+            f"max_length = {total_len}"
+        )
+    return model, cache_len
+
+
 def _sample(logits, rng, cfg: GenerationConfig):
     if cfg.decode_strategy == "greedy":
         return jnp.argmax(logits, axis=-1)
@@ -147,6 +168,7 @@ def generate(
             f"prompt_len({prompt_len}) + max_length({gen_cfg.max_length}) "
             f"exceeds max_position_embeddings({max_pos})"
         )
+    model, cache_len = right_size_decode_cache(model, total_len)
 
     params = variables["params"] if "params" in variables else variables
     if attention_mask is None:
@@ -159,9 +181,9 @@ def generate(
     # everything generated afterwards is real
     kv_valid = jnp.concatenate(
         [attention_mask.astype(bool),
-         jnp.ones((b, max_pos - prompt_len), bool)], axis=1,
+         jnp.ones((b, cache_len - prompt_len), bool)], axis=1,
     )
-    kv_mask = kv_valid[:, None, None, :]  # [b, 1, 1(q), max_pos(kv)]
+    kv_mask = kv_valid[:, None, None, :]  # [b, 1, 1(q), cache_len(kv)]
     # buffer-slot validity for the repetition penalty
     token_valid = jnp.concatenate(
         [attention_mask.astype(bool),

@@ -90,6 +90,11 @@ class GPTConfig:
     # hybrid_model.py:1095)
     virtual_pp_degree: int = 1
     balance_loss_weight: float = 0.01
+    # decode kv-cache length; None = max_position_embeddings. Generation
+    # drivers set this to prompt_len + max_length so per-step cache traffic
+    # (attention reads, beam reorders) scales with the actual decode span,
+    # not the model's position ceiling.
+    decode_cache_len: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -236,7 +241,9 @@ class SelfAttention(nn.Module):
         [batch, max_len, heads, head_dim]."""
         is_init = not self.has_variable("cache", "cached_key")
         b, s, nh, hd = k.shape
-        max_len = self.cfg.max_position_embeddings
+        max_len = (self.cfg.decode_cache_len
+                   if self.cfg.decode_cache_len is not None
+                   else self.cfg.max_position_embeddings)
         ck = self.variable(
             "cache", "cached_key", jnp.zeros, (b, max_len, nh, hd), k.dtype
         )

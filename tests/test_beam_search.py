@@ -220,3 +220,32 @@ def test_left_padded_prompt_matches_unpadded_beam(model_and_params):
     )
     cont_padded = out[0, :, 6:]
     np.testing.assert_array_equal(cont_plain, cont_padded)
+
+
+def test_right_sized_cache_matches_full_cache(model_and_params):
+    """Decode output must be identical whether the kv cache is right-sized
+    to prompt+max_length (the default) or allocated at the full
+    max_position_embeddings (the pre-optimization behavior) — for both the
+    beam path (suffix-only gather) and greedy."""
+    import dataclasses
+
+    model, params = model_and_params
+    full = model.clone(cfg=dataclasses.replace(
+        CFG, decode_cache_len=CFG.max_position_embeddings))
+    ids = jnp.asarray([[3, 11, 5, 2], [9, 1, 4, 8]], jnp.int32)
+    bs_cfg = GenerationConfig(
+        max_length=8, decode_strategy="beam_search", num_beams=3,
+        eos_token_id=EOS, pad_token_id=0,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(beam_search(model, params, ids, bs_cfg)),
+        np.asarray(beam_search(full, params, ids, bs_cfg)),
+    )
+    gr_cfg = GenerationConfig(
+        max_length=8, decode_strategy="sampling", top_k=1,
+        eos_token_id=EOS, pad_token_id=0,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(generate(model, params, ids, gr_cfg)),
+        np.asarray(generate(full, params, ids, gr_cfg)),
+    )
