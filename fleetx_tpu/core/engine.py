@@ -709,11 +709,27 @@ class Trainer:
             args=ocp.args.Composite(
                 state=ocp.args.StandardSave(_unbox(self.state)),
                 meta=ocp.args.JsonSave(
-                    {"epoch": epoch, "consumed_samples": self.consumed_samples}
+                    {"epoch": epoch, "consumed_samples": self.consumed_samples,
+                     # the dropout noise stream is defined by these two
+                     # switches (ops/dropout.py HashDropout vs nn.Dropout;
+                     # flash kernel hash vs hardware PRNG) — record them so
+                     # a resume under flipped flags is detectable instead of
+                     # silently changing the masks mid-run
+                     "dropout_impl": self._dropout_impl()}
                 ),
             ),
         )
         logger.info("saved checkpoint at step %d -> %s", step, self.output_dir)
+
+    def _dropout_impl(self) -> dict:
+        from fleetx_tpu.ops.pallas.flash_attention import HW_RNG
+
+        model_cfg = (getattr(self.cfg, "Model", None) or {})
+        return {
+            "flash_hw_rng": bool(HW_RNG),
+            # HashDropout vs nn.Dropout for the hidden dropouts
+            "fast_dropout": bool(model_cfg.get("fast_dropout", True)),
+        }
 
     def load(self, step: Optional[int] = None):
         """Restore; resumes step count, epoch, and data order
@@ -757,6 +773,14 @@ class Trainer:
         meta = restored["meta"]
         self.start_epoch = meta.get("epoch", 0)
         self.consumed_samples = meta.get("consumed_samples", 0)
+        saved_impl = meta.get("dropout_impl")
+        if saved_impl is not None and saved_impl != self._dropout_impl():
+            logger.warning(
+                "checkpoint was trained with dropout_impl=%s but this run "
+                "uses %s — the dropout noise stream will differ from an "
+                "uninterrupted run (set FLEETX_FLASH_HW_RNG to match)",
+                saved_impl, self._dropout_impl(),
+            )
         self._restored_step = step
         logger.info("restored checkpoint step %d (epoch %d)", step, self.start_epoch)
         return True

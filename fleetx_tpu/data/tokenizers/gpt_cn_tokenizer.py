@@ -41,7 +41,8 @@ class SentencePieceUnigram:
 
     def __init__(self, pieces: Dict[str, float], ids: Dict[str, int],
                  unk_id: int = 0, unk_piece: str = "<unk>",
-                 escape_whitespaces: bool = True):
+                 escape_whitespaces: bool = True,
+                 byte_ids: Optional[Dict[int, int]] = None):
         self.scores = pieces
         self.ids = ids
         self.id_to_piece = {i: p for p, i in ids.items()}
@@ -54,6 +55,13 @@ class SentencePieceUnigram:
         # unk must stay strictly worse than any real single piece
         self.unk_score = min(pieces.values(), default=0.0) - 10.0
         self.eos_id: Optional[int] = None  # set by from_file when present
+        # byte value -> BYTE(6) piece id; true byte-fallback alphabet. Real
+        # sentencepiece keeps byte pieces OUT of the lattice (literal text
+        # "<0x41>" segments as plain characters) and uses them only to
+        # encode characters no piece covers — same here: the unk branch
+        # emits the char's UTF-8 bytes when the alphabet is present.
+        self.byte_ids = byte_ids or {}
+        self._byte_vals = {pid: b for b, pid in self.byte_ids.items()}
 
     @classmethod
     def from_file(cls, model_file: str) -> "SentencePieceUnigram":
@@ -64,6 +72,7 @@ class SentencePieceUnigram:
             proto.ParseFromString(f.read())
         pieces: Dict[str, float] = {}
         ids: Dict[str, int] = {}
+        byte_ids: Dict[int, int] = {}
         unk_id, unk_piece = 0, "<unk>"
         eos_id: Optional[int] = None
         for i, p in enumerate(proto.pieces):
@@ -75,17 +84,17 @@ class SentencePieceUnigram:
                 continue
             if p.type in (3, 5):  # CONTROL/UNUSED: id only, never
                 continue          # segmented from raw text
-            # NORMAL(1) keeps its trained log-prob; USER_DEFINED(4) and
-            # BYTE(6) must stay reachable in the Viterbi too — real
-            # sentencepiece segments user-defined pieces with their stored
-            # score (0.0, i.e. maximally preferred), and byte pieces are
-            # the <unk> fallback alphabet
+            if p.type == 6:  # BYTE "<0xNN>": fallback alphabet, NOT a
+                byte_ids[int(p.piece[3:5], 16)] = i  # surface candidate
+                continue
+            # NORMAL(1) keeps its trained log-prob; USER_DEFINED(4) is
+            # segmented with its stored score (0.0, maximally preferred)
             pieces[p.piece] = p.score
         escape = True
         if proto.HasField("normalizer_spec") and proto.normalizer_spec.HasField(
                 "escape_whitespaces"):
             escape = proto.normalizer_spec.escape_whitespaces
-        sp = cls(pieces, ids, unk_id, unk_piece, escape)
+        sp = cls(pieces, ids, unk_id, unk_piece, escape, byte_ids)
         sp.eos_id = eos_id
         return sp
 
@@ -111,20 +120,46 @@ class SentencePieceUnigram:
                     back[i + length] = (i, self.ids[sub])
             if best[i] + self.unk_score > best[i + 1]:
                 best[i + 1] = best[i] + self.unk_score
-                back[i + 1] = (i, self.unk_id)
+                # true byte-fallback: a char no piece covers becomes its
+                # UTF-8 bytes via the <0xNN> alphabet (same lattice score
+                # as unk, so segmentation is unchanged); <unk> only when
+                # the model ships no byte pieces
+                ch = text[i].encode("utf-8")
+                if self.byte_ids and all(b in self.byte_ids for b in ch):
+                    back[i + 1] = (i, tuple(self.byte_ids[b] for b in ch))
+                else:
+                    back[i + 1] = (i, self.unk_id)
         out: List[int] = []
         pos = n
         while pos > 0:
             prev, piece_id = back[pos]
-            out.append(piece_id)
+            if isinstance(piece_id, tuple):
+                out.extend(reversed(piece_id))
+            else:
+                out.append(piece_id)
             pos = prev
         out.reverse()
         return out
 
     def decode(self, ids) -> str:
-        return "".join(
-            self.id_to_piece.get(int(i), self.unk_piece) for i in ids
-        )
+        # runs of byte pieces decode as UTF-8 byte strings
+        parts: List[str] = []
+        pending: List[int] = []
+
+        def flush():
+            if pending:
+                parts.append(bytes(pending).decode("utf-8", errors="replace"))
+                pending.clear()
+
+        for i in ids:
+            i = int(i)
+            if i in self._byte_vals:
+                pending.append(self._byte_vals[i])
+                continue
+            flush()
+            parts.append(self.id_to_piece.get(i, self.unk_piece))
+        flush()
+        return "".join(parts)
 
 
 class GPTChineseTokenizer:

@@ -20,13 +20,17 @@ Attention dropout runs *inside* the kernel with zero extra HBM traffic —
 the reference reaches the same determinism via its CUDA RNG tracker
 ``local_seed`` (/root/reference/ppfleetx/distributed/apis/env.py:49-54).
 Two deterministic bit sources:
-- real TPUs: the hardware PRNG (``pltpu.prng_seed/prng_random_bits``),
-  seeded per (seed, batch*head, q-tile, k-tile) so the forward and both
-  backward kernels regenerate identical bits for congruent tiles
-  (``FLEETX_FLASH_HW_RNG=0`` opts out);
-- CPU interpreter (and the opt-out): a counter-based integer hash
-  (lowbias32 finalizer) of (seed, batch*head, q_pos, k_pos) — plain int32
-  arithmetic the host-side tests reproduce bit-for-bit.
+- default (every backend): a counter-based integer hash (lowbias32
+  finalizer) of (seed, GLOBAL batch*head, q_pos, k_pos) — plain int32
+  arithmetic the host-side tests reproduce bit-for-bit, and
+  layout-invariant across dp/mp/cp shardings by construction;
+- ``FLEETX_FLASH_HW_RNG=1`` opt-in (real TPUs): the hardware PRNG
+  (``pltpu.prng_seed/prng_random_bits``), seeded per (seed, batch*head,
+  q-tile, k-tile). Cheaper per tile, but keyed on TILE ids — only
+  self-consistent between identically-tiled kernels, and unverified on
+  hardware until the TPU-gated test_hw_rng_* suite passes on a live chip
+  (ADVICE r4); flip the default only then. Either source must be held
+  fixed for the life of a training run (checkpoints record it).
 
 Layout: q, k, v are [batch, seq, heads, head_dim] (model layout).
 
@@ -160,9 +164,16 @@ def dropout_keep_scale(seed, bh, q_pos, k_pos, rate: float):
     return keep.astype(jnp.float32) / (1.0 - rate)
 
 
-# FLEETX_FLASH_HW_RNG=0 forces the lowbias32 hash bit source on real TPUs
-# too (the interpreter always uses it) — see the module docstring
-HW_RNG = _os.environ.get("FLEETX_FLASH_HW_RNG", "1") == "1"
+# FLEETX_FLASH_HW_RNG=1 switches real-TPU dropout bits to the hardware
+# PRNG (pltpu.prng_*); the default is the lowbias32 hash on every backend.
+# Default OFF (ADVICE r4 medium): the HW path assumes bit-layout agreement
+# across the three separately-compiled kernels, which only the TPU-gated
+# test_hw_rng_* tests can certify — and they have not yet run on a live
+# chip. Flip the default only after they pass on hardware. Either source
+# must be held constant across a training run: the realized masks differ,
+# so toggling mid-run (or resuming on the other setting) changes the
+# noise stream.
+HW_RNG = _os.environ.get("FLEETX_FLASH_HW_RNG", "0") == "1"
 
 
 def _tile_keep_scale(seed, bh, qb, kb, q_col, k_row, shape, rate: float,
@@ -954,9 +965,23 @@ def flash_attention(
     instead of replicating it; ``mesh_shard=False`` opts out (the pp>1
     stage-vmap path must — see fleetx_tpu/ops/attention.py)."""
     b, s, h, _ = q.shape
+    want_q = block_q
     block_q, block_k = fit_blocks(s, block_q, block_k)
     if block_q is None:
         raise ValueError(f"seq {s} not tileable (must be a multiple of 8)")
+    if block_q < min(128, want_q) and block_q != s:
+        # the model path pre-screens with _tileable (ops/attention.py), but
+        # direct callers can land on sequences whose largest divisor tile is
+        # tiny — a silent 10x+ perf cliff vs the requested blocks
+        import warnings
+
+        warnings.warn(
+            f"flash_attention: seq {s} only admits {block_q}x{block_k} "
+            f"tiles (requested {want_q}); per-grid-step overhead will "
+            "dominate — pad the sequence to a multiple of 128 or use the "
+            "XLA path",
+            stacklevel=2,
+        )
     if dropout_rate > 0.0:
         if dropout_rng is None:
             raise ValueError("dropout_rate > 0 requires dropout_rng")

@@ -90,9 +90,10 @@ def test_eos_token_id_missing_raises(tmp_path):
 
 def test_user_defined_and_byte_pieces_are_segmentable(tmp_path):
     """USER_DEFINED pieces (score 0.0 in the proto) must win the Viterbi —
-    real sentencepiece always extracts them; BYTE pieces stay reachable as
-    the fallback alphabet. Before the fix both were id-only and degraded
-    to <unk>."""
+    real sentencepiece always extracts them. BYTE pieces are the fallback
+    alphabet ONLY (ADVICE r4): a character no piece covers encodes to its
+    UTF-8 bytes via <0xNN>, while literal text "<0x41>" segments as plain
+    characters, never as the byte piece."""
     from transformers.utils import sentencepiece_model_pb2_new as pb2
 
     proto = pb2.ModelProto()
@@ -118,4 +119,15 @@ def test_user_defined_and_byte_pieces_are_segmentable(tmp_path):
     sp = SentencePieceUnigram.from_file(str(path))
     pieces = [sp.id_to_piece[i] for i in sp.encode("你<sep>好")]
     assert pieces == ["你", "<sep>", "好"]
-    assert [sp.id_to_piece[i] for i in sp.encode("<0x41>")] == ["<0x41>"]
+    # byte-fallback: 'A' (0x41) has no NORMAL piece but is in the byte
+    # alphabet -> its UTF-8 byte piece; round-trips through decode
+    ids = sp.encode("你A好")
+    assert [sp.id_to_piece[i] for i in ids] == ["你", "<0x41>", "好"]
+    assert sp.decode(ids) == "你A好"
+    # literal "<0x41>" is six characters of text, NOT the byte piece; none
+    # of them ('<','0','x','4','1','>') is in this model's byte alphabet,
+    # so each degrades to <unk> — the byte piece must never surface-match
+    lit = [sp.id_to_piece[i] for i in sp.encode("<0x41>")]
+    assert lit == ["<unk>"] * 6
+    # chars with no byte piece available degrade to <unk>
+    assert [sp.id_to_piece[i] for i in sp.encode("Z")] == ["<unk>"]

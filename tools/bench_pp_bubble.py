@@ -1,0 +1,120 @@
+"""Pipeline-bubble measurement: step time vs num_microbatches (VERDICT r4
+item #8).
+
+The SPMD pipe (fleetx_tpu/parallel/pipeline.py) answers the reference's
+interleaved-1F1B runtime schedule (/root/reference/ppfleetx/models/
+language_model/gpt/dygraph/hybrid_model.py:1095) with "raise
+num_microbatches" — the scan streams M microbatches through pp stages in
+M + pp - 1 ticks, so the bubble fraction is (pp-1)/(M+pp-1) and shrinks
+with M at constant global batch. This harness measures that claim: jitted
+fwd+bwd wall time per GLOBAL batch at fixed global batch size, sweeping M,
+on the virtual CPU mesh (relative shape is what matters; absolute CPU
+times are not TPU times).
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/bench_pp_bubble.py --out benchmarks/pp_bubble.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+
+def measure(pp: int, microbatches, global_batch: int = 16, seq: int = 128,
+            repeats: int = 3):
+    import flax
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from fleetx_tpu.models.gpt.model import (
+        GPTConfig, GPTForPretraining, pretraining_loss,
+    )
+    from fleetx_tpu.parallel.mesh import MeshConfig, build_mesh, use_mesh
+    from fleetx_tpu.parallel.pipeline import sequential_params_to_pipeline
+    from fleetx_tpu.parallel.sharding import make_rules
+
+    base = dict(
+        vocab_size=256, hidden_size=256, num_layers=8,
+        num_attention_heads=4, ffn_hidden_size=1024,
+        max_position_embeddings=seq, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, dtype=jnp.float32,
+        use_flash_attention=False,
+    )
+    devs = jax.devices()
+    dp = max(1, len(devs[: 8]) // pp)
+    mesh = build_mesh(MeshConfig(dp=dp, pp=pp), devs[: dp * pp])
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 256, (global_batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, 256, (global_batch, seq)), jnp.int32)
+    mask = jnp.ones((global_batch, seq), jnp.float32)
+
+    seq_model = GPTForPretraining(GPTConfig(**base))
+    v_seq = seq_model.init(jax.random.PRNGKey(0), tokens[:1, :8])
+    unboxed = jax.tree.map(
+        lambda v: v.value if hasattr(v, "value") else v,
+        flax.core.unfreeze(v_seq["params"]),
+        is_leaf=lambda v: hasattr(v, "value"),
+    )
+    v_pipe = sequential_params_to_pipeline({"params": unboxed}, pp)
+
+    records = []
+    for m in microbatches:
+        model = GPTForPretraining(
+            GPTConfig(**{**base, "pp_degree": pp, "num_microbatches": m})
+        )
+
+        def loss_fn(params, tokens, labels, mask):
+            logits = model.apply(params, tokens)
+            return pretraining_loss(logits, labels, mask)
+
+        with use_mesh(mesh), nn.logical_axis_rules(list(make_rules())):
+            step = jax.jit(jax.grad(loss_fn))
+            g = step(v_pipe, tokens, labels, mask)  # compile + warm
+            jax.block_until_ready(g)
+            times = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                g = step(v_pipe, tokens, labels, mask)
+                jax.tree.leaves(jax.device_get(
+                    jax.tree.map(lambda x: x.sum(), g)))  # hard sync
+                times.append(time.perf_counter() - t0)
+        bubble = (pp - 1) / (m + pp - 1)
+        records.append({
+            "pp": pp, "num_microbatches": m, "global_batch": global_batch,
+            "step_s": round(float(np.median(times)), 4),
+            "model_bubble_fraction": round(bubble, 4),
+        })
+        print(json.dumps(records[-1]), flush=True)
+    return records
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    from fleetx_tpu.utils.device_guard import honor_platform_env
+
+    honor_platform_env()
+    records = []
+    records += measure(2, (1, 2, 4, 8, 16), repeats=args.repeats)
+    records += measure(4, (1, 2, 4, 8, 16), repeats=args.repeats)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(records, f, indent=1)
+    return records
+
+
+if __name__ == "__main__":
+    main()
