@@ -119,6 +119,11 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
             # scan-carry dynamic-update-slice traffic (~9%/step in the r4
             # profile at 345M)
             scan_layers=os.environ.get("BENCH_SCAN", "1") == "1",
+            # BENCH_FUSED_CE=1: blockwise fused LM-head + cross-entropy
+            # (ops/pallas/ce_loss.py) — the [tokens, 50304] f32 logits
+            # never materialize (~1.6 GB at b8) at +2 recompute matmul
+            # passes in backward
+            fused_ce=os.environ.get("BENCH_FUSED_CE", "0") == "1",
         ),
         Optimizer=AttrDict(
             name="FusedAdamW",

@@ -95,6 +95,11 @@ class GPTConfig:
     # (attention reads, beam reorders) scales with the actual decode span,
     # not the model's position ceiling.
     decode_cache_len: Optional[int] = None
+    # fuse the LM head matmul + cross-entropy into the Pallas blockwise
+    # kernel (ops/pallas/ce_loss.py): the [tokens, vocab] logits never
+    # materialize. Opt-in; intended for mp=1 runs (a vocab-sharded
+    # embedding would be gathered around the kernel).
+    fused_ce: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -484,7 +489,7 @@ class GPTForPretraining(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, position_ids=None, attn_mask=None, *,
-                 deterministic=True, decode=False):
+                 deterministic=True, decode=False, labels=None):
         backbone = GPTModel(self.cfg, name="gpt")
         x = backbone(
             input_ids,
@@ -495,6 +500,18 @@ class GPTForPretraining(nn.Module):
         )
         word_emb = backbone.variables["params"]["word_embeddings"]
         emb = word_emb.value if isinstance(word_emb, nn.Partitioned) else word_emb
+        if labels is not None and self.cfg.fused_ce:
+            # blockwise fused LM-head + CE: returns PER-TOKEN loss [b, s]
+            # (callers apply loss_mask); the [b, s, vocab] logits never
+            # exist — ops/pallas/ce_loss.py
+            from fleetx_tpu.ops.pallas.ce_loss import fused_linear_ce
+
+            b, s, hd = x.shape
+            tok = fused_linear_ce(
+                x.reshape(b * s, hd), emb.astype(self.cfg.dtype),
+                labels.reshape(-1),
+            )
+            return tok.reshape(b, s)
         logits = jnp.einsum(
             "bsh,vh->bsv", x, emb.astype(self.cfg.dtype),
             preferred_element_type=jnp.float32,
@@ -571,6 +588,13 @@ def convert_qkv_layout(gpt_params: dict, to_fused: bool) -> dict:
     return walk(gpt_params)
 
 
+def masked_loss_mean(token_loss: jax.Array, loss_mask: jax.Array):
+    """Loss-mask-weighted mean of per-token losses (the reference
+    criterion's reduction, single_model.py:727-736)."""
+    loss_mask = loss_mask.astype(jnp.float32).reshape(token_loss.shape)
+    return (token_loss * loss_mask).sum() / jnp.maximum(loss_mask.sum(), 1.0)
+
+
 def pretraining_loss(logits: jax.Array, labels: jax.Array, loss_mask: jax.Array):
     """Masked LM cross-entropy (reference GPTPretrainingCriterion,
     single_model.py:702-736; the TP ParallelCrossEntropy variant
@@ -579,6 +603,4 @@ def pretraining_loss(logits: jax.Array, labels: jax.Array, loss_mask: jax.Array)
     logits = logits.astype(jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)
     label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-    token_loss = logz - label_logits
-    loss_mask = loss_mask.astype(jnp.float32).reshape(token_loss.shape)
-    return (token_loss * loss_mask).sum() / jnp.maximum(loss_mask.sum(), 1.0)
+    return masked_loss_mean(logz - label_logits, loss_mask)

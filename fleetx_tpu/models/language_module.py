@@ -198,6 +198,24 @@ class GPTModule(LanguageModule):
         if cp > 1:
             extra["cp_degree"] = cp
         gcfg = GPTConfig(**{**gcfg.__dict__, **extra})
+        if gcfg.fused_ce:
+            # the fused LM-head+CE kernel needs an aligned vocab block and
+            # is validated for mp=1/cp=1 only (a vocab-sharded embedding
+            # would be gathered around the kernel) — demote to the XLA
+            # logits path instead of crashing at trace time
+            from fleetx_tpu.ops.pallas.ce_loss import fit_vocab_block
+
+            mp = dist.get("mp_degree") or 1
+            why = None
+            if fit_vocab_block(gcfg.vocab_size) is None:
+                why = f"vocab {gcfg.vocab_size} admits no 128-aligned block"
+            elif mp > 1 or cp > 1:
+                why = f"mp_degree={mp}/cp_degree={cp} (validated for 1/1)"
+            if why:
+                logger.warning(
+                    "Model.fused_ce disabled: %s; using the XLA logits "
+                    "path", why)
+                gcfg = GPTConfig(**{**gcfg.__dict__, "fused_ce": False})
         self.gpt_config = gcfg
         return GPTForPretraining(gcfg)
 
@@ -245,12 +263,25 @@ class GPTModule(LanguageModule):
 
     def loss_fn(self, params, batch, rng, train: bool):
         tokens, position_ids, labels, loss_mask = self.cp_prepare(batch)
+        rngs = {"dropout": rng} if train and rng is not None else None
+        if (getattr(self.gpt_config, "fused_ce", False)
+                and labels.size % 8 == 0):
+            # fused LM-head+CE path: the model returns per-token losses
+            # and [b, s, vocab] logits never materialize (Model.fused_ce,
+            # ops/pallas/ce_loss.py)
+            from fleetx_tpu.models.gpt.model import masked_loss_mean
+
+            token_loss = self.nets.apply(
+                {"params": params}, tokens, position_ids,
+                deterministic=not train, rngs=rngs, labels=labels,
+            )
+            return masked_loss_mean(token_loss, loss_mask), {}
         logits = self.nets.apply(
             {"params": params},
             tokens,
             position_ids,
             deterministic=not train,
-            rngs={"dropout": rng} if train and rng is not None else None,
+            rngs=rngs,
         )
         loss = pretraining_loss(logits, labels, loss_mask)
         return loss, {}

@@ -37,7 +37,16 @@ SWEEP = [
     {"BENCH_BATCH": "16", "BENCH_SCAN": "0"},
     {"BENCH_BATCH": "16", "FLEETX_FLASH_BLOCK_Q": "256",
      "FLEETX_FLASH_BLOCK_K": "256"},
+    # hardware-PRNG dropout bits vs the default hash: only meaningful
+    # AFTER the kernel tests (incl. the hw_rng_on-forced test_hw_rng_*)
+    # have passed on this chip — the sweep runs after them by construction
+    {"BENCH_BATCH": "16", "FLEETX_FLASH_HW_RNG": "1"},
+    # fused LM-head+CE kernel: trades ~1.6 GB of logits HBM traffic for
+    # two recompute matmul passes; also frees headroom for larger batch
+    {"BENCH_BATCH": "16", "BENCH_FUSED_CE": "1"},
     {"BENCH_BATCH": "32"},
+    {"BENCH_BATCH": "32", "BENCH_FUSED_CE": "1",
+     "BENCH_MOMENT_DTYPE": "bfloat16"},
 ]
 
 
@@ -72,6 +81,7 @@ def main():
             "BENCH_EXTRA_SAVES": "", "BENCH_MOMENT_DTYPE": "",
             "BENCH_SCAN": "1",
             "FLEETX_FLASH_BLOCK_Q": "512", "FLEETX_FLASH_BLOCK_K": "512",
+            "FLEETX_FLASH_HW_RNG": "0", "BENCH_FUSED_CE": "0",
             # sweep wants the anchor train record only — no decode bench,
             # no second-batch record (they triple the per-point wall time)
             "BENCH_EXTRA": "0",
