@@ -209,13 +209,17 @@ class GPTModule(LanguageModule):
             why = None
             if fit_vocab_block(gcfg.vocab_size) is None:
                 why = f"vocab {gcfg.vocab_size} admits no 128-aligned block"
-            elif mp > 1 or cp > 1:
-                why = f"mp_degree={mp}/cp_degree={cp} (validated for 1/1)"
+            elif mp > 1 or cp > 1 or pp > 1:
+                why = (f"mp_degree={mp}/cp_degree={cp}/pp_degree={pp} "
+                       "(validated for 1/1/1)")
             if why:
                 logger.warning(
                     "Model.fused_ce disabled: %s; using the XLA logits "
                     "path", why)
                 gcfg = GPTConfig(**{**gcfg.__dict__, "fused_ce": False})
+        sharding = dist.get("sharding") or {}
+        self._data_world = (dist.get("dp_degree") or 1) * (
+            sharding.get("sharding_degree") or 1)
         self.gpt_config = gcfg
         return GPTForPretraining(gcfg)
 
@@ -264,8 +268,12 @@ class GPTModule(LanguageModule):
     def loss_fn(self, params, batch, rng, train: bool):
         tokens, position_ids, labels, loss_mask = self.cp_prepare(batch)
         rngs = {"dropout": rng} if train and rng is not None else None
+        nd = getattr(self, "_data_world", 1)
+        # per-SHARD token count must stay 8-aligned (the kernel shard_maps
+        # over dp/fsdp); otherwise fall back to the logits path
+        shard_ok = labels.size % nd != 0 or (labels.size // nd) % 8 == 0
         if (getattr(self.gpt_config, "fused_ce", False)
-                and labels.size % 8 == 0):
+                and labels.size % 8 == 0 and shard_ok):
             # fused LM-head+CE path: the model returns per-token losses
             # and [b, s, vocab] logits never materialize (Model.fused_ce,
             # ops/pallas/ce_loss.py)

@@ -510,13 +510,17 @@ def _bwd_dkv_kernel(seed_ref, kvlens_ref, meta_ref, q_ref, k_ref, v_ref,
         dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
         dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
 
-    @pl.when(im >= first_im)
+    kvlen = kvlens_ref[bh]
+    gbh, q_off, k_off = _global_ids(meta_ref, bh)
+
+    # skip entirely when this k block sits fully past the kv cut: every
+    # score is masked, dk/dv stay zero (the init/finalize still run) —
+    # saves the all-tiles masked walk for heavily right-padded rows
+    @pl.when((im >= first_im) & (k_off + j * bk < kvlen))
     def _step():
         mm_dt = _mm_dtype(k_ref.dtype)
         k = k_ref[:].astype(mm_dt)
         v = v_ref[:].astype(mm_dt)
-        kvlen = kvlens_ref[bh]
-        gbh, q_off, k_off = _global_ids(meta_ref, bh)
         k_row = k_off + j * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
 
         def body(t, carry, masked: bool):

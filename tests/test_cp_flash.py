@@ -199,3 +199,35 @@ def test_model_cp_attention_dropout_runs(eight_devices):
             )
         )(params, tokens)
     assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_model_cp_flash_under_remat(eight_devices):
+    """cp2 ring-flash inside nn.remat (selective recompute): the custom
+    VJP must compose with jax.checkpoint over the scanned layer stack."""
+    from fleetx_tpu.models.gpt.model import (
+        GPTConfig, GPTForPretraining, pretraining_loss,
+    )
+
+    cfg = GPTConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_attention_heads=4,
+        ffn_hidden_size=64, max_position_embeddings=32,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        use_flash_attention=False, cp_degree=2, dtype=jnp.float32,
+        use_recompute=True, recompute_granularity="core_attn",
+    )
+    model = GPTForPretraining(cfg)
+    mesh = build_mesh(MeshConfig(cp=2), eight_devices[:2])
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 64, (2, 32)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, 64, (2, 32)), jnp.int32)
+    mask = jnp.ones((2, 32), jnp.float32)
+    with use_mesh(mesh):
+        params = model.init(jax.random.PRNGKey(0), tokens)
+
+        def loss(p):
+            return pretraining_loss(model.apply(p, tokens), labels, mask)
+
+        l, g = jax.jit(jax.value_and_grad(loss))(params)
+    assert np.isfinite(float(l))
+    gn = float(jnp.sqrt(sum(jnp.sum(x ** 2) for x in jax.tree.leaves(g))))
+    assert np.isfinite(gn) and gn > 0
