@@ -1,20 +1,32 @@
 """Test harness: force an 8-device virtual CPU platform so every parallelism
 strategy (dp/fsdp/mp/pp/sp/ep collectives) is exercised without a TPU —
-the unit-test pyramid the reference lacks (SURVEY.md §4)."""
+the unit-test pyramid the reference lacks (SURVEY.md §4).
+
+FLEETX_TEST_PLATFORM=real skips the CPU pin so the suite runs against the
+attached accelerator (tools/tpu_preflight.py sets it: without this escape
+hatch the conftest pin silently rehomed the "real backend" kernel
+certification onto the virtual CPU platform, and the TPU-gated
+``_on_tpu()`` tests never ran anywhere).
+"""
 
 import os
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the sandbox presets JAX_PLATFORMS=axon
+_REAL = os.environ.get("FLEETX_TEST_PLATFORM") == "real"
+
+if not _REAL:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"  # the sandbox presets JAX_PLATFORMS=axon
 os.environ.setdefault("FLEETX_LOG_LEVEL", "WARNING")
 
 import jax  # noqa: E402
 
-# The sandbox's sitecustomize registers an 'axon' TPU backend and pins
-# jax_platforms to it; re-pin to the virtual 8-device CPU platform.
-jax.config.update("jax_platforms", "cpu")
+if not _REAL:
+    # The sandbox's sitecustomize registers an 'axon' TPU backend and pins
+    # jax_platforms to it; re-pin to the virtual 8-device CPU platform.
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
@@ -24,5 +36,7 @@ def eight_devices():
     import jax
 
     devs = jax.devices()
-    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    if len(devs) < 8:
+        pytest.skip(f"needs 8 virtual devices, have {len(devs)} "
+                    "(FLEETX_TEST_PLATFORM=real?)")
     return devs

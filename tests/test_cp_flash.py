@@ -231,3 +231,15 @@ def test_model_cp_flash_under_remat(eight_devices):
     assert np.isfinite(float(l))
     gn = float(jnp.sqrt(sum(jnp.sum(x ** 2) for x in jax.tree.leaves(g))))
     assert np.isfinite(gn) and gn > 0
+
+
+def test_flash_ring_long_sequence_2048(eight_devices):
+    """Long-context smoke: 2048-seq cp4 ring (s_blk 256, multi-tile kernel
+    calls per hop) against the XLA reference — the CPU-side stand-in for
+    the TPU-gated 32k case (tests/test_flash_attention.py)."""
+    q, k, v = _qkv(b=1, s=2048, h=2, d=32)
+    mesh = build_mesh(MeshConfig(cp=4), eight_devices[:4])
+    out = _ring(q, k, v, mesh, 4)
+    ref = causal_attention(q, k, v, use_flash=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)

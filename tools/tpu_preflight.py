@@ -1,11 +1,14 @@
 """One-shot real-TPU validation + perf sweep, for when a chip is attached.
 
 Runs, in order:
-1. the flash-attention kernel tests on the REAL backend (Mosaic lowering,
-   not the interpreter) — fwd/grad parity incl. the non-causal / kv_lens /
-   dropout paths;
-2. bench.py under a small sweep of batch size x remat x flash block size,
-   printing each JSON line and the best configuration.
+1. the flash-attention AND fused-CE kernel tests on the REAL backend
+   (Mosaic lowering, not the interpreter; FLEETX_TEST_PLATFORM=real
+   bypasses the test conftest's CPU pin) — fwd/grad parity incl. the
+   non-causal / kv_lens / dropout paths and the TPU-only gated cases
+   (32k streaming, hardware-PRNG certification);
+2. bench.py under a small sweep of batch size x remat x flash block size
+   x dropout bit source x fused-CE, printing each JSON line and the best
+   configuration.
 
     python tools/tpu_preflight.py            # full
     python tools/tpu_preflight.py --no-sweep # kernel tests only
@@ -56,12 +59,20 @@ def main():
     ap.add_argument("--steps", default="10")
     args = ap.parse_args()
 
-    print("== flash kernel tests on the real backend ==", flush=True)
+    print("== kernel tests on the real backend ==", flush=True)
     r = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/test_flash_attention.py",
+         # ce kernel: Mosaic-level tests only (the mesh/module cases need
+         # the 8-device CPU platform)
+         "tests/test_ce_loss.py", "-k", "not mesh and not module",
          "-x", "-q", "-p", "no:cacheprovider"],
         cwd=REPO,
-        env={**os.environ, "JAX_PLATFORMS": "", "FLEETX_LOG_LEVEL": "WARNING"},
+        # FLEETX_TEST_PLATFORM=real: without it the tests/conftest.py CPU
+        # pin would silently rehome this "real backend" certification onto
+        # the virtual CPU platform (and skip every _on_tpu()-gated case)
+        env={**os.environ, "JAX_PLATFORMS": "",
+             "FLEETX_TEST_PLATFORM": "real",
+             "FLEETX_LOG_LEVEL": "WARNING"},
     )
     if r.returncode != 0:
         sys.exit("kernel tests FAILED on the real backend; fix before benching")
