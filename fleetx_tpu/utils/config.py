@@ -189,12 +189,10 @@ def process_dist_config(cfg: AttrDict, nranks: Optional[int] = None) -> AttrDict
     if model.get("sequence_parallel") and mp <= 1:
         logger.warning("sequence_parallel=True with mp_degree<=1 has no effect; disabling")
         model["sequence_parallel"] = False
-    if cp > 1 and (model.get("attention_probs_dropout_prob") or 0) > 0:
-        logger.warning(
-            "cp_degree>1 (ring attention) does not support attention dropout; "
-            "forcing attention_probs_dropout_prob=0"
-        )
-        model["attention_probs_dropout_prob"] = 0.0
+    # (r5) attention dropout under cp_degree>1 is supported: it runs inside
+    # the ring's per-hop flash kernels with position-keyed bits, so the
+    # realized mask equals the cp=1 kernel's (parallel/context_parallel.py);
+    # the old forcing-to-0 guard is gone.
     return cfg
 
 

@@ -48,8 +48,11 @@ CASES_8 = {
         "Distributed.sharding.sharding_degree": 4,
         "Distributed.sharding.sharding_stage": 2,
     },
-    "DP4-CP2": {"Distributed.dp_degree": 4, "Distributed.cp_degree": 2,
-                "Model.attention_probs_dropout_prob": 0.0},
+    # r5: attention dropout now runs under cp (inside the per-hop flash
+    # kernels, position-keyed so the realized mask matches cp=1); hidden
+    # dropout's mask assignment permutes with the zig-zag order — same
+    # distribution, different stream, within this grid's 3% loss gate
+    "DP4-CP2": {"Distributed.dp_degree": 4, "Distributed.cp_degree": 2},
     "DP8-Recompute": {"Distributed.dp_degree": 8,
                       "Model.use_recompute": True,
                       "Model.recompute_granularity": "core_attn"},
@@ -71,8 +74,7 @@ CASES_16 = {
         "Distributed.sharding.sharding_degree": 2,
         "Distributed.sharding.sharding_stage": 2,
     },
-    "DP8-CP2": {"Distributed.dp_degree": 8, "Distributed.cp_degree": 2,
-                "Model.attention_probs_dropout_prob": 0.0},
+    "DP8-CP2": {"Distributed.dp_degree": 8, "Distributed.cp_degree": 2},
 }
 CASES_32 = {
     "DP32-MP1-PP1": {"Distributed.dp_degree": 32},
