@@ -55,6 +55,9 @@ class ViTConfig:
     hidden_act: str = "gelu_tanh"
     # hash-based hidden dropout (ops/dropout.py); False restores nn.Dropout
     fast_dropout: bool = True
+    # flash attention for the encoder blocks (seq 197 pads to a single
+    # 200-row kernel tile in ops/attention.py); False restores XLA attention
+    use_flash_attention: bool = True
     use_recompute: bool = False
     dtype: Dtype = jnp.bfloat16
 
@@ -135,7 +138,9 @@ class ViTBlock(nn.Module):
             dropout_rate=cfg.attn_drop_rate,
             dropout_rng=dropout_rng,
             deterministic=deterministic,
-            use_flash=False,
+            # seq 197 (196 patches + cls) pads to 200 inside the dispatch
+            # (one kernel tile); use_flash_attention: False restores XLA
+            use_flash=cfg.use_flash_attention,
         )
         y = attn_out_dense(cfg.hidden_size, cfg.dtype)(y)
         y = dropout_layer(cfg.drop_rate, "proj_drop", cfg.fast_dropout)(y, deterministic=deterministic)

@@ -107,14 +107,27 @@ def causal_attention(
         # overhead regardless of size
         return bq is not None and (bq >= 128 or bq == s)
 
+    def _pad_to_tileable(s: int):
+        """Smallest padded length with a kernel-worthy tile, or None.
+        Padded KEYS are masked via kv_lens; padded QUERY rows are computed
+        and sliced off (their cotangent is zero, so gradients are exact).
+        Fixes e.g. ViT's 197 (-> 200, one tile) and 1016 (-> 1024, 512
+        tiles) instead of falling back to the XLA path."""
+        for s_pad in range(s + (-s % 8), s + 129, 8):
+            if _tileable(s_pad):
+                return s_pad
+        return None
+
     import os as _os
 
+    s = q.shape[1]
+    s_pad = s if _tileable(s) else _pad_to_tileable(s)
     can_flash = (
         use_flash
         and attn_mask is None
         and (effective_dropout == 0.0 or dropout_rng is not None)
         and q.shape[1] == k.shape[1]  # not incremental decode
-        and _tileable(q.shape[1])
+        and s_pad is not None
         and (
             jax.default_backend() in ("tpu", "axon")
             # interpreter-mode kernel on CPU: the multichip dryrun uses this
@@ -125,11 +138,17 @@ def causal_attention(
     if can_flash:
         from fleetx_tpu.ops.pallas.flash_attention import flash_attention
 
-        return flash_attention(
+        if s_pad != s:
+            pad = ((0, 0), (0, s_pad - s), (0, 0), (0, 0))
+            if kv_lens is None:
+                kv_lens = jnp.full((q.shape[0],), s, jnp.int32)
+            q, k, v = (jnp.pad(t, pad) for t in (q, k, v))
+        out = flash_attention(
             q, k, v, causal=causal, kv_lens=kv_lens,
             dropout_rate=effective_dropout, dropout_rng=dropout_rng,
             mesh_shard=mesh_shard,
         )
+        return out[:, :s] if s_pad != s else out
     if kv_lens is not None:
         key_valid = (
             jnp.arange(k.shape[1])[None, :] < kv_lens[:, None]

@@ -189,10 +189,26 @@ def process_dist_config(cfg: AttrDict, nranks: Optional[int] = None) -> AttrDict
     if model.get("sequence_parallel") and mp <= 1:
         logger.warning("sequence_parallel=True with mp_degree<=1 has no effect; disabling")
         model["sequence_parallel"] = False
-    # (r5) attention dropout under cp_degree>1 is supported: it runs inside
-    # the ring's per-hop flash kernels with position-keyed bits, so the
-    # realized mask equals the cp=1 kernel's (parallel/context_parallel.py);
-    # the old forcing-to-0 guard is gone.
+    # (r5) attention dropout under cp_degree>1 runs inside the ring's
+    # per-hop flash kernels with position-keyed bits, so the realized mask
+    # equals the cp=1 kernel's (parallel/context_parallel.py). The old
+    # forcing-to-0 guard survives ONLY for configurations the flash ring
+    # cannot serve (explicit FLEETX_CP_FLASH=0, or a local block below the
+    # 8-row tile) — there the jnp ring path has no dropout support and
+    # would raise deep inside shard_map tracing.
+    if cp > 1 and (model.get("attention_probs_dropout_prob") or 0) > 0:
+        seq = ((cfg.get("Data") or {}).get("Train") or {}).get(
+            "dataset", {}).get("max_seq_len")
+        flash_off = os.environ.get("FLEETX_CP_FLASH") == "0"
+        untileable = seq is not None and (seq // (2 * cp)) % 8 != 0
+        if flash_off or untileable:
+            logger.warning(
+                "cp_degree>1 with attention dropout needs the flash ring "
+                "path (%s); forcing attention_probs_dropout_prob=0",
+                "FLEETX_CP_FLASH=0 set" if flash_off
+                else f"seq {seq} / (2*cp={2 * cp}) is not 8-row tileable",
+            )
+            model["attention_probs_dropout_prob"] = 0.0
     return cfg
 
 

@@ -577,3 +577,69 @@ def test_kv_lens_grads_across_major_blocks_512():
             np.asarray(a), np.asarray(b), rtol=1e-2, atol=1e-3,
             err_msg=f"d{name} mismatch",
         )
+
+
+# ------------------------------------------------- pad-to-tileable dispatch
+
+def test_dispatch_pads_untileable_seq_to_kernel(monkeypatch):
+    """seq 197 (ViT) routes to the kernel via padding instead of the XLA
+    fallback: the dispatch pads to 200 (one tile), masks padded keys with
+    kv_lens, and slices padded query rows off."""
+    from fleetx_tpu.ops import attention as attn_mod
+
+    calls = {"n": 0}
+    orig = flash_attention
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setenv("FLEETX_FORCE_FLASH", "1")
+    monkeypatch.setattr(
+        "fleetx_tpu.ops.pallas.flash_attention.flash_attention", counting)
+    q, k, v = _qkv(b=2, s=197, h=2, d=32)
+    out = attn_mod.causal_attention(q, k, v, causal=False)
+    assert calls["n"] == 1, "padded dispatch did not reach the kernel"
+    assert out.shape == q.shape
+    ref = _reference_attention(q, k, v, causal=False, attn_mask=None,
+                               dropout_rate=0.0, dropout_rng=None,
+                               deterministic=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_dispatch_pad_grads_exact(monkeypatch):
+    """Padded-row cotangents are zero, so gradients through the padded
+    dispatch equal the XLA reference's."""
+    from fleetx_tpu.ops import attention as attn_mod
+
+    monkeypatch.setenv("FLEETX_FORCE_FLASH", "1")
+    q, k, v = _qkv(b=1, s=197, h=2, d=32)
+
+    def loss_pad(q, k, v):
+        return (attn_mod.causal_attention(q, k, v, causal=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_reference_attention(
+            q, k, v, causal=True, attn_mask=None, dropout_rate=0.0,
+            dropout_rng=None, deterministic=True) ** 2).sum()
+
+    gp = jax.grad(loss_pad, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gp, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_dispatch_pad_composes_with_kv_lens(monkeypatch):
+    """ERNIE-style: caller kv_lens AND the pad mask must both apply."""
+    from fleetx_tpu.ops import attention as attn_mod
+
+    monkeypatch.setenv("FLEETX_FORCE_FLASH", "1")
+    q, k, v = _qkv(b=2, s=197, h=2, d=32)
+    kv_lens = jnp.asarray([100, 197], jnp.int32)
+    out = attn_mod.causal_attention(q, k, v, causal=False, kv_lens=kv_lens)
+    ref = _ref_masked(q, k, v, kv_lens=kv_lens, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)

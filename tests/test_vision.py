@@ -143,3 +143,18 @@ def test_cls_module_end_to_end(tmp_path, eight_devices):
     loader = build_dataloader(cfg, "Train")
     trainer.fit(loader)
     assert int(trainer.state.step) == 4
+
+
+def test_vit_flash_matches_xla(monkeypatch):
+    """Flash-routed ViT encoder (seq 17 pads to a single kernel tile) must
+    match the XLA attention path."""
+    imgs = jnp.asarray(np.random.default_rng(0).random((2, 32, 32, 3)),
+                       jnp.float32)
+    xla_model = ViT(ViTConfig(**{**TINY.__dict__,
+                                 "use_flash_attention": False}))
+    vars_ = xla_model.init(jax.random.PRNGKey(0), imgs)
+    ref = xla_model.apply(vars_, imgs)
+    monkeypatch.setenv("FLEETX_FORCE_FLASH", "1")
+    out = ViT(TINY).apply(vars_, imgs)  # flash default ON
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)

@@ -7,25 +7,33 @@
 # Launch DETACHED at round start (never under a tool/CI timeout that could
 # kill a process mid-TPU-access — killed clients are what wedge the tunnel):
 #   nohup tools/tpu_watch.sh >/dev/null 2>&1 &
-# Logs: $LOG_DIR (default /tmp). Done marker: $LOG_DIR/tpu_pipeline_done.
+# Results land INSIDE the repo ($LOG_DIR, default benchmarks/tpu_watch/) so
+# the round-end driver commit banks them even if the session has ended.
+# Done marker: $LOG_DIR/tpu_pipeline_done. Health log: /tmp/tpu_health.log
+# (high-churn, deliberately outside the repo).
 set -u
-LOG_DIR="${LOG_DIR:-/tmp}"
 cd "$(dirname "$0")/.."
+LOG_DIR="${LOG_DIR:-benchmarks/tpu_watch}"
+mkdir -p "$LOG_DIR"
 
-note() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG_DIR/tpu_health.log"; }
+note() { echo "$(date -u +%H:%M:%S) $*" | tee -a /tmp/tpu_health.log \
+         >> "$LOG_DIR/pipeline_status.log"; }
 
 while true; do
   if timeout 60 python -c "import jax; jax.devices()" >/dev/null 2>&1; then break; fi
-  note "wedged"
+  echo "$(date -u +%H:%M:%S) wedged" >> /tmp/tpu_health.log
   sleep 240
 done
 note "HEALTHY - starting pipeline"
 python tools/tpu_preflight.py --no-sweep > "$LOG_DIR/kernel_tests.log" 2>&1
 note "kernel tests rc=$?"
-BENCH_EXTRA=0 BENCH_BATCH=16 python bench.py > "$LOG_DIR/bench_b16_quick.txt" 2>/dev/null
+BENCH_EXTRA=0 BENCH_BATCH=16 python bench.py > "$LOG_DIR/bench_b16_quick.json" 2>/dev/null
 note "quick b16 bench rc=$?"
 python tools/tpu_preflight.py > "$LOG_DIR/preflight_sweep.log" 2>&1
 note "sweep rc=$?"
-python bench.py > "$LOG_DIR/bench_full.txt" 2> "$LOG_DIR/bench_full_err.txt"
+python bench.py > "$LOG_DIR/bench_full.json" 2> "$LOG_DIR/bench_full_err.log"
 note "full bench rc=$?"
+python tools/bench_decode.py > "$LOG_DIR/decode_records.json" 2>/dev/null
+note "decode bench rc=$?"
 touch "$LOG_DIR/tpu_pipeline_done"
+note "pipeline complete"
