@@ -120,6 +120,18 @@ def causal_attention(
 
     import os as _os
 
+    def _unwrapped_under_tp() -> bool:
+        # mesh_shard=False (the pp stage-vmap path) with an ambient mp>1
+        # mesh: the bare Pallas call would make GSPMD replicate the
+        # heads-sharded q/k/v — strictly worse than the XLA attention it
+        # replaces, which GSPMD shards natively. Prefer the XLA path.
+        if mesh_shard:
+            return False
+        from fleetx_tpu.parallel.mesh import ambient_mesh
+
+        mesh = ambient_mesh()
+        return mesh is not None and dict(mesh.shape).get("mp", 1) > 1
+
     s = q.shape[1]
     s_pad = s if _tileable(s) else _pad_to_tileable(s)
     can_flash = (
@@ -128,6 +140,7 @@ def causal_attention(
         and (effective_dropout == 0.0 or dropout_rng is not None)
         and q.shape[1] == k.shape[1]  # not incremental decode
         and s_pad is not None
+        and not _unwrapped_under_tp()
         and (
             jax.default_backend() in ("tpu", "axon")
             # interpreter-mode kernel on CPU: the multichip dryrun uses this

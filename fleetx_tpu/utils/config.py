@@ -199,7 +199,9 @@ def process_dist_config(cfg: AttrDict, nranks: Optional[int] = None) -> AttrDict
     if cp > 1 and (model.get("attention_probs_dropout_prob") or 0) > 0:
         seq = ((cfg.get("Data") or {}).get("Train") or {}).get(
             "dataset", {}).get("max_seq_len")
-        flash_off = os.environ.get("FLEETX_CP_FLASH") == "0"
+        # mirror context_parallel._cp_flash_enabled: any value but "1"
+        # disables the flash ring
+        flash_off = os.environ.get("FLEETX_CP_FLASH", "1") != "1"
         untileable = seq is not None and (seq // (2 * cp)) % 8 != 0
         if flash_off or untileable:
             logger.warning(

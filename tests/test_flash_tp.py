@@ -144,3 +144,28 @@ def test_mp2_lowering_keeps_kernel_local_shapes(eight_devices):
         assert not any(global_ in ln for ln in call_lines), (
             "kernel saw the GLOBAL shape — GSPMD replicated the operands"
         )
+
+
+def test_unwrapped_flash_under_mp_mesh_prefers_xla(eight_devices,
+                                                   monkeypatch):
+    """mesh_shard=False (the pp stage-vmap path) under an mp>1 mesh must
+    NOT dispatch the bare kernel — GSPMD would replicate the heads-sharded
+    operands around it; the XLA path shards natively."""
+    from fleetx_tpu.ops import attention as attn_mod
+
+    calls = {"n": 0}
+    orig = flash_attention
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setenv("FLEETX_FORCE_FLASH", "1")
+    monkeypatch.setattr(
+        "fleetx_tpu.ops.pallas.flash_attention.flash_attention", counting)
+    q, k, v = _qkv()
+    with use_mesh(_mesh(eight_devices, mp=2)):
+        attn_mod.causal_attention(q, k, v, mesh_shard=False)
+        assert calls["n"] == 0, "bare kernel dispatched under TP"
+        attn_mod.causal_attention(q, k, v)  # wrapped path still flashes
+        assert calls["n"] == 1
