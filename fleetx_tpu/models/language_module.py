@@ -199,19 +199,23 @@ class GPTModule(LanguageModule):
             extra["cp_degree"] = cp
         gcfg = GPTConfig(**{**gcfg.__dict__, **extra})
         if gcfg.fused_ce:
-            # the fused LM-head+CE kernel needs an aligned vocab block and
-            # is validated for mp=1/cp=1 only (a vocab-sharded embedding
-            # would be gathered around the kernel) — demote to the XLA
-            # logits path instead of crashing at trace time
+            # the fused LM-head+CE kernel needs a lane-aligned PER-SHARD
+            # vocab block (mp>1 runs the vocab-parallel form); cp/pp stay
+            # demoted — fall back to the XLA logits path instead of
+            # crashing at trace time
             from fleetx_tpu.ops.pallas.ce_loss import fit_vocab_block
 
             mp = dist.get("mp_degree") or 1
             why = None
-            if fit_vocab_block(gcfg.vocab_size) is None:
-                why = f"vocab {gcfg.vocab_size} admits no 128-aligned block"
-            elif mp > 1 or cp > 1 or pp > 1:
-                why = (f"mp_degree={mp}/cp_degree={cp}/pp_degree={pp} "
-                       "(validated for 1/1/1)")
+            if gcfg.vocab_size % mp or fit_vocab_block(
+                    gcfg.vocab_size // mp) is None:
+                why = (f"vocab {gcfg.vocab_size} / mp {mp} admits no "
+                       "lane-aligned block (128-multiple or 64)")
+            elif cp > 1 or pp > 1:
+                # mp>1 is supported (vocab-parallel kernel); cp would
+                # gather the seq-sharded hidden states and pp runs the
+                # loss outside the validated path
+                why = f"cp_degree={cp}/pp_degree={pp} (validated for 1/1)"
             if why:
                 logger.warning(
                     "Model.fused_ce disabled: %s; using the XLA logits "

@@ -22,9 +22,12 @@ HBM:
   1.6 GB live-activation peak — the final staged lever in
   docs/PERFORMANCE.md.
 
-Requires the vocab to admit a lane-aligned block (a multiple of 128
-dividing V, e.g. 384 | 50304); callers fall back to the XLA path
-otherwise. Tokens dim must be a multiple of 8.
+Requires the (per-shard) vocab to admit a lane-aligned block — a
+128-multiple <= 512 dividing it, or the 64-lane fallback (see
+``fit_vocab_block``); callers fall back to the XLA path otherwise.
+Tokens dim must be a multiple of 8. Under an mp>1 mesh the
+vocab-parallel form shards the embedding and combines per-shard
+(logsumexp, label-logit) stats outside the shard_map region.
 """
 
 from __future__ import annotations
@@ -53,11 +56,17 @@ def _params_2d():
 
 
 def fit_vocab_block(v: int, want: int = 512):
-    """Largest multiple of 128 that divides ``v`` and is <= want (None if
-    no 128-multiple divides — the caller then uses the XLA path)."""
+    """Largest lane-aligned block dividing ``v`` and <= want, or None (the
+    caller then uses the XLA path). Preference: a multiple of 128 (full
+    lanes); fallback: 64 (Mosaic also accepts last block dims DIVIDING
+    128, and 64 keeps half the lanes — e.g. the GPT vocab 50304 sharded
+    mp2 is 25152 = 64*393, 128-unaligned). Below 64 the lane waste makes
+    the kernel pointless, so smaller divisors demote instead."""
     for bv in range(want - want % 128, 127, -128):
         if v % bv == 0:
             return bv
+    if v % 64 == 0:
+        return 64
     return None
 
 
@@ -105,8 +114,8 @@ def _fwd_kernel(labels_ref, h_ref, w_ref, loss_ref, lse_ref, m_scr, l_scr,
         loss_ref[:] = lse - lab_scr[:]
 
 
-def _dh_kernel(labels_ref, g_ref, lse_ref, h_ref, w_ref, dh_ref, dh_scr, *,
-               block_v: int, n_v: int):
+def _dh_kernel(labels_ref, a_ref, b_ref, lse_ref, h_ref, w_ref, dh_ref,
+               dh_scr, *, block_v: int, n_v: int):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -121,7 +130,11 @@ def _dh_kernel(labels_ref, g_ref, lse_ref, h_ref, w_ref, dh_ref, dh_scr, *,
     )
     p = jnp.exp(s - lse_ref[:])  # softmax via saved logsumexp
     col = j * block_v + jax.lax.broadcasted_iota(jnp.int32, (1, block_v), 1)
-    dl = g_ref[:] * (p - jnp.where(labels_ref[:] == col, 1.0, 0.0))
+    # generalized cotangent dl = a*softmax + b*onehot: the plain CE
+    # backward is (a, b) = (g, -g); the vocab-parallel stats primitive
+    # feeds the cotangents of (lse_loc, lab_loc) directly
+    dl = (a_ref[:] * p
+          + b_ref[:] * jnp.where(labels_ref[:] == col, 1.0, 0.0))
     dh_scr[:] = dh_scr[:] + jax.lax.dot_general(
         dl.astype(mm), w, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -132,8 +145,8 @@ def _dh_kernel(labels_ref, g_ref, lse_ref, h_ref, w_ref, dh_ref, dh_scr, *,
         dh_ref[:] = dh_scr[:].astype(dh_ref.dtype)
 
 
-def _dw_kernel(labels_ref, g_ref, lse_ref, h_ref, w_ref, dw_ref, dw_scr, *,
-               block_t: int, n_t: int, block_v: int):
+def _dw_kernel(labels_ref, a_ref, b_ref, lse_ref, h_ref, w_ref, dw_ref,
+               dw_scr, *, block_t: int, n_t: int, block_v: int):
     j = pl.program_id(0)  # vocab block (parallel)
     i = pl.program_id(1)  # token stream (sequential)
 
@@ -149,7 +162,8 @@ def _dw_kernel(labels_ref, g_ref, lse_ref, h_ref, w_ref, dw_ref, dw_scr, *,
     )  # [bt, bv]
     p = jnp.exp(s - lse_ref[:])
     col = j * block_v + jax.lax.broadcasted_iota(jnp.int32, (1, block_v), 1)
-    dl = g_ref[:] * (p - jnp.where(labels_ref[:] == col, 1.0, 0.0))
+    dl = (a_ref[:] * p
+          + b_ref[:] * jnp.where(labels_ref[:] == col, 1.0, 0.0))
     dw_scr[:] = dw_scr[:] + jax.lax.dot_general(
         dl.astype(mm), h, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -208,11 +222,20 @@ def _fused_ce_bwd(block_t, block_v, res, g):
     v = w.shape[0]
     n_t, n_v = n // block_t, v // block_v
     g2 = g.astype(jnp.float32)[:, None]  # [n, 1]
+    dh = _dh_call(lab2, g2, -g2, lse, h, w, block_t, block_v)
+    dw = _dw_call(lab2, g2, -g2, lse, h, w, block_t, block_v)
+    dlabels = np.zeros(lab2.shape[:1], dtype=jax.dtypes.float0)
+    return dh, dw, dlabels
 
-    dh = pl.pallas_call(
+
+def _dh_call(lab2, a2, b2, lse, h, w, block_t, block_v):
+    n, d = h.shape
+    n_t, n_v = n // block_t, w.shape[0] // block_v
+    return pl.pallas_call(
         functools.partial(_dh_kernel, block_v=block_v, n_v=n_v),
         grid=(n_t, n_v),
         in_specs=[
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
             pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
@@ -224,13 +247,19 @@ def _fused_ce_bwd(block_t, block_v, res, g):
         scratch_shapes=[pltpu.VMEM((block_t, d), jnp.float32)],
         compiler_params=_params_2d(),
         interpret=_interpret(),
-    )(lab2, g2, lse, h, w)
+    )(lab2, a2, b2, lse, h, w)
 
-    dw = pl.pallas_call(
+
+def _dw_call(lab2, a2, b2, lse, h, w, block_t, block_v):
+    n, d = h.shape
+    v = w.shape[0]
+    n_t, n_v = n // block_t, v // block_v
+    return pl.pallas_call(
         functools.partial(_dw_kernel, block_t=block_t, n_t=n_t,
                           block_v=block_v),
         grid=(n_v, n_t),
         in_specs=[
+            pl.BlockSpec((block_t, 1), lambda j, i: (i, 0)),
             pl.BlockSpec((block_t, 1), lambda j, i: (i, 0)),
             pl.BlockSpec((block_t, 1), lambda j, i: (i, 0)),
             pl.BlockSpec((block_t, 1), lambda j, i: (i, 0)),
@@ -242,13 +271,58 @@ def _fused_ce_bwd(block_t, block_v, res, g):
         scratch_shapes=[pltpu.VMEM((block_v, d), jnp.float32)],
         compiler_params=_params_2d(),
         interpret=_interpret(),
-    )(lab2, g2, lse, h, w)
+    )(lab2, a2, b2, lse, h, w)
 
+
+_fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+
+
+# ------------------------------------------------ vocab-parallel (TP) form
+# The reference's vocab-parallel LM head + ParallelCrossEntropy
+# (hybrid_model.py:49-71, 857-904) as a kernel: each mp shard runs the
+# SAME Pallas kernels over its vocab shard and returns per-shard
+# (logsumexp, label-logit) stats on a MENTIONED mp output axis; the
+# cross-shard combine (exact logsumexp + sum) happens OUTSIDE the
+# shard_map in plain jnp, where autodiff is trivially exact. (Replicated
+# outputs under check_vma=False transpose with an ambiguous scale — the
+# stats formulation sidesteps that entirely.) The stats primitive's VJP
+# uses the generalized kernel cotangent dl = a*softmax_local + b*onehot.
+
+def _local_labels(labels, v_loc: int, mp_axis: str):
+    """Global label ids -> this shard's local ids; off-shard -> -1 (matches
+    no column, so the local label-logit stays 0 and the cross-shard sum
+    recovers exactly the owning shard's value)."""
+    shard = jax.lax.axis_index(mp_axis)
+    l_loc = labels.astype(jnp.int32) - shard * v_loc
+    return jnp.where((l_loc >= 0) & (l_loc < v_loc), l_loc, -1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _vp_stats(h, w_shard, l_loc, block_t, block_v):
+    out, _ = _vp_stats_fwd(h, w_shard, l_loc, block_t, block_v)
+    return out
+
+
+def _vp_stats_fwd(h, w_shard, l_loc, block_t, block_v):
+    loss_loc, (_, _, lab2, lse) = _fused_ce_fwd(
+        h, w_shard, l_loc, block_t, block_v)
+    lse1 = lse[:, 0]
+    lab1 = lse1 - loss_loc  # 0 when the label lives on another shard
+    return (lse1, lab1), (h, w_shard, lab2, lse)
+
+
+def _vp_stats_bwd(block_t, block_v, res, cts):
+    h, w_shard, lab2, lse = res
+    ca, cb = cts  # cotangents of (lse_loc, lab_loc)
+    a2 = ca.astype(jnp.float32)[:, None]
+    b2 = cb.astype(jnp.float32)[:, None]
+    dh = _dh_call(lab2, a2, b2, lse, h, w_shard, block_t, block_v)
+    dw = _dw_call(lab2, a2, b2, lse, h, w_shard, block_t, block_v)
     dlabels = np.zeros(lab2.shape[:1], dtype=jax.dtypes.float0)
     return dh, dw, dlabels
 
 
-_fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+_vp_stats.defvjp(_vp_stats_fwd, _vp_stats_bwd)
 
 
 def fused_linear_ce(hidden: jax.Array, emb: jax.Array,
@@ -258,38 +332,68 @@ def fused_linear_ce(hidden: jax.Array, emb: jax.Array,
     (same dtype), labels [n] int — returns [n] f32 token losses
     (callers apply loss_mask / normalization).
 
-    Under an ambient mesh with dp/fsdp extents the call shard_maps over
-    the token dim (embedding replicated into the region — mp>1
-    vocab-sharded embeddings should keep the XLA path, Model.fused_ce
-    doc). Raises ValueError when (n, v) admit no aligned blocks —
-    callers gate with :func:`fit_vocab_block` and fall back to the XLA
-    path."""
+    Under an ambient mesh the call shard_maps over the token dim
+    (dp/fsdp) and, when mp > 1, over the VOCAB dim of the embedding too
+    (vocab-parallel: per-shard stats combined outside the region).
+    Raises ValueError when no lane-aligned blocks fit — callers gate
+    with :func:`fit_vocab_block` on the PER-SHARD vocab (v // mp) and
+    fall back to the XLA logits path."""
     n, d = hidden.shape
     v = emb.shape[0]
     block_v = fit_vocab_block(v)
     if block_v is None:
         raise ValueError(
-            f"fused_linear_ce: no 128-multiple block divides vocab {v}"
+            f"fused_linear_ce: vocab {v} admits no lane-aligned block "
+            "(need a 128-multiple <= 512 dividing it, or 64 | v)"
         )
 
-    mesh = None
     from fleetx_tpu.parallel.mesh import ambient_mesh
 
-    m = ambient_mesh()
-    if m is not None:
-        sizes = dict(m.shape)
-        n_data = sizes.get("dp", 1) * sizes.get("fsdp", 1)
-        if n_data > 1 and n % n_data == 0:
-            mesh = m
-            n_local = n // n_data
+    mesh = ambient_mesh()
+    n_data, n_mp = 1, 1
     if mesh is not None:
+        sizes = dict(mesh.shape)
+        n_data = sizes.get("dp", 1) * sizes.get("fsdp", 1)
+        n_mp = sizes.get("mp", 1)
+        if n % n_data or (n_mp > 1 and v % n_mp):
+            mesh = None  # indivisible: run unsharded (GSPMD replicates)
+    if mesh is not None and n_data * n_mp > 1:
         from jax.sharding import PartitionSpec as P
 
+        n_local = n // n_data
         block_t = _fit_token_block(n_local)
         if block_t is None:
             raise ValueError(f"fused_linear_ce: 8 must divide {n_local}")
         data_axes = tuple(a for a in ("dp", "fsdp")
                           if dict(mesh.shape).get(a, 1) > 1)
+        if n_mp > 1:
+            # vocab-parallel: embedding sharded over mp; per-shard stats
+            # come back on a MENTIONED mp axis and combine outside (see
+            # the vocab-parallel section above)
+            v_loc = v // n_mp
+            block_v_loc = fit_vocab_block(v_loc)
+            if block_v_loc is None:
+                raise ValueError(
+                    f"fused_linear_ce: vocab shard {v_loc} admits no "
+                    "lane-aligned block"
+                )
+
+            def body(h_, w_, l_):
+                lse1, lab1 = _vp_stats(
+                    h_, w_, _local_labels(l_, v_loc, "mp"),
+                    block_t, block_v_loc)
+                return lse1[None, :], lab1[None, :]
+
+            fn = jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(data_axes, None), P("mp", None), P(data_axes)),
+                out_specs=(P("mp", data_axes), P("mp", data_axes)),
+                check_vma=False,
+            )
+            lse_stack, lab_stack = fn(hidden, emb, labels)  # [mp, n]
+            return (jax.scipy.special.logsumexp(lse_stack, axis=0)
+                    - lab_stack.sum(axis=0))
         fn = jax.shard_map(
             # custom_vjp statics must stay positional
             lambda h_, w_, l_: _fused_ce(h_, w_, l_, block_t, block_v),

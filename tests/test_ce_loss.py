@@ -36,8 +36,10 @@ def _ref_token_loss(h, w, labels):
 def test_fit_vocab_block():
     assert fit_vocab_block(50304) == 384  # GPT vocab: 384 | 50304
     assert fit_vocab_block(512) == 512
-    assert fit_vocab_block(1000) is None  # no 128-multiple divides
+    assert fit_vocab_block(1000) is None  # no lane-aligned block divides
     assert fit_vocab_block(130048, want=512) == 512
+    assert fit_vocab_block(25152) == 64   # GPT vocab / mp2: 64-lane fallback
+    assert fit_vocab_block(12576) is None  # below the 64-lane floor
 
 
 def test_forward_matches_reference():
@@ -202,9 +204,134 @@ def test_module_demotes_fused_ce_when_ineligible(eight_devices, tmp_path):
         process_configs(c, nranks=8)
         return c
 
-    m = build_module(cfg(50257))  # GPT-2 vocab: no 128-multiple divides
-    assert not m.gpt_config.fused_ce
-    m = build_module(cfg(50304, mp=2))  # aligned vocab but mp>1
+    m = build_module(cfg(50257))  # GPT-2 vocab: no lane-aligned block
     assert not m.gpt_config.fused_ce
     m = build_module(cfg(50304))
     assert m.gpt_config.fused_ce
+    # mp2 is now SUPPORTED via the vocab-parallel kernel (see
+    # test_module_fused_ce_allows_mp)
+
+
+def test_mesh_vocab_parallel_matches_unsharded(eight_devices):
+    """mp2 (and dp2 x mp2): the embedding shards over the vocab dim and the
+    global logsumexp/label-logit combine across shards — forward and both
+    grads must match the unsharded kernel."""
+    from fleetx_tpu.parallel.mesh import MeshConfig, build_mesh, use_mesh
+
+    h, w, labels = _hwl(n=64, v=768)  # 768 = 2 x 384: aligned per shard
+
+    def loss(a, b):
+        return (fused_linear_ce(a, b, labels) ** 2).sum()
+
+    ref = fused_linear_ce(h, w, labels)
+    gr = jax.grad(loss, argnums=(0, 1))(h, w)
+    for degrees in (dict(mp=2), dict(dp=2, mp=2)):
+        mesh = build_mesh(MeshConfig(**degrees), eight_devices[:4])
+        with use_mesh(mesh):
+            out = fused_linear_ce(h, w, labels)
+            gm = jax.grad(loss, argnums=(0, 1))(h, w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        for a, b, name in zip(gm, gr, ("dh", "dw")):
+            # f32 accumulation order differs between the sharded and
+            # unsharded walks; values reach O(100)
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=5e-4,
+                                       err_msg=f"{name} {degrees}")
+
+
+def test_mesh_vocab_parallel_vs_logits_reference(eight_devices):
+    """mp2 fused CE vs the dense logsumexp reference (not just the
+    unsharded kernel): catches errors common to both kernel paths."""
+    from fleetx_tpu.parallel.mesh import MeshConfig, build_mesh, use_mesh
+
+    h, w, labels = _hwl(n=64, v=768, seed=3)
+    mesh = build_mesh(MeshConfig(mp=2), eight_devices[:2])
+    with use_mesh(mesh):
+        out = fused_linear_ce(h, w, labels)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_ref_token_loss(h, w, labels)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_module_fused_ce_allows_mp(eight_devices, tmp_path):
+    """mp>1 no longer demotes (vocab-parallel path); unaligned shard does."""
+    from fleetx_tpu.models import build_module
+    from fleetx_tpu.utils.config import AttrDict, process_configs
+
+    def cfg(vocab, mp):
+        c = AttrDict(
+            Global=AttrDict(seed=0, global_batch_size=8),
+            Engine=AttrDict(max_steps=1, logging_freq=1,
+                            mix_precision=AttrDict(use_pure_fp16=False),
+                            save_load=AttrDict(save_steps=10**9,
+                                               output_dir=str(tmp_path))),
+            Model=AttrDict(module="GPTModule", vocab_size=vocab,
+                           hidden_size=32, num_layers=2,
+                           num_attention_heads=2, ffn_hidden_size=64,
+                           max_position_embeddings=32,
+                           hidden_dropout_prob=0.0,
+                           attention_probs_dropout_prob=0.0,
+                           fused_ce=True, use_flash_attention=False),
+            Optimizer=AttrDict(
+                name="AdamW", weight_decay=0.0,
+                lr=AttrDict(name="CosineAnnealingWithWarmupDecay",
+                            decay_steps=10, max_lr=1e-3, min_lr=1e-4)),
+            Distributed=AttrDict(dp_degree=8 // mp, mp_degree=mp),
+        )
+        process_configs(c, nranks=8)
+        return c
+
+    # mp2: vocab shard 25152 = 64*393 -> 64-lane fallback block, allowed
+    assert build_module(cfg(50304, 2)).gpt_config.fused_ce
+    # mp4: shard 12576 = 32*393 -> below the 64-lane floor, demoted
+    assert not build_module(cfg(50304, 4)).gpt_config.fused_ce
+
+
+def test_kernels_lower_for_tpu_64_block():
+    """The 64-lane fallback block (GPT vocab / mp2 = 25152 = 64*393) must
+    survive Mosaic lowering, not just the interpreter — last block dims
+    that DIVIDE 128 are legal but this is the only place we prove it."""
+    import fleetx_tpu.ops.pallas.ce_loss as ce
+
+    assert fit_vocab_block(25152) == 64
+    orig = ce._interpret
+    ce._interpret = lambda: False
+    try:
+        # v=448 = 64*7: forces block_v=64 (no 128-multiple divides)
+        h, w, labels = _hwl(n=64, d=128, v=448, dtype=jnp.bfloat16)
+        assert fit_vocab_block(448) == 64
+
+        def fwd(h, w):
+            return fused_linear_ce(h, w, labels).sum()
+
+        def bwd(h, w):
+            return jax.grad(fwd, argnums=(0, 1))(h, w)
+
+        jax.jit(fwd).trace(h, w).lower(lowering_platforms=("tpu",))
+        jax.jit(bwd).trace(h, w).lower(lowering_platforms=("tpu",))
+    finally:
+        ce._interpret = orig
+
+
+def test_mesh_vocab_parallel_64_block_shard(eight_devices):
+    """mp2 over v=384: each shard is 192 = 64*3, exercising the 64-lane
+    fallback through the vocab-parallel path end to end."""
+    from fleetx_tpu.parallel.mesh import MeshConfig, build_mesh, use_mesh
+
+    h, w, labels = _hwl(n=64, v=384, seed=5)
+    assert fit_vocab_block(192) == 64
+    ref = _ref_token_loss(h, w, labels)
+    mesh = build_mesh(MeshConfig(mp=2), eight_devices[:2])
+    with use_mesh(mesh):
+        out = fused_linear_ce(h, w, labels)
+        g = jax.grad(lambda a, b: (fused_linear_ce(a, b, labels) ** 2).sum(),
+                     argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    gr = jax.grad(lambda a, b: (_ref_token_loss(a, b, labels) ** 2).sum(),
+                  argnums=(0, 1))(h, w)
+    for a, b, name in zip(g, gr, ("dh", "dw")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=5e-4,
+                                   err_msg=name)
