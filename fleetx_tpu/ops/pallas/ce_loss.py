@@ -174,10 +174,6 @@ def _dw_kernel(labels_ref, a_ref, b_ref, lse_ref, h_ref, w_ref, dw_ref,
         dw_ref[:] = dw_scr[:].astype(dw_ref.dtype)
 
 
-def _smem():
-    return pl.BlockSpec(memory_space=pltpu.SMEM)
-
-
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _fused_ce(h, w, labels, block_t, block_v):
     out, _ = _fused_ce_fwd(h, w, labels, block_t, block_v)
