@@ -255,6 +255,28 @@ def _decode_records_subprocess(timeout_s: int):
 
 
 def main():
+    # Fast tunnel probe (the proven tpu_watch.sh pattern): on a wedged
+    # tunnel each stage would otherwise burn its own 300s guard serially
+    # (decode child first, then the parent) — ~10 min to fail. A throwaway
+    # child either acquires and exits cleanly in seconds or proves the
+    # wedge quickly. Skipped only when the platform override targets the
+    # host CPU (nothing to probe there).
+    if os.environ.get("BENCH_PLATFORM", "") != "cpu":
+        import subprocess
+
+        probe_s = int(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
+        try:
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True, timeout=probe_s,
+            )
+        except subprocess.TimeoutExpired as e:
+            tail = (e.stderr or b"").decode("utf-8", "replace")[-300:]
+            sys.stderr.write(
+                f"bench: device probe exceeded {probe_s}s (TPU tunnel "
+                f"wedged?); aborting. probe stderr tail: {tail}\n")
+            sys.exit(3)
+
     extras = []
     if os.environ.get("BENCH_EXTRA", "1") != "0":
         # decode first: the child must own the chip before the parent does
