@@ -25,7 +25,12 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from fleetx_tpu.models.gpt.generation import GenerationConfig, process_logits
+from fleetx_tpu.models.gpt.generation import (
+    GenerationConfig,
+    mark_seen,
+    process_logits,
+    prompt_seen,
+)
 
 __all__ = ["beam_search"]
 
@@ -129,10 +134,6 @@ def beam_search(
         axis=1,
     )
     kv_mask = kv_valid[:, None, None, :]
-    token_valid = jnp.concatenate(
-        [am_f.astype(bool), jnp.ones((b * nb, total_len - prompt_len), bool)],
-        axis=1,
-    )
 
     ids_f = jnp.repeat(input_ids.astype(jnp.int32), nb, axis=0)
     tokens = jnp.full((b * nb, total_len), pad, jnp.int32)
@@ -190,6 +191,12 @@ def beam_search(
     prefill_logits = jnp.repeat(logits[:, -1, :], nb, axis=0)
 
     vocab = prefill_logits.shape[-1]
+    # [b*nb, vocab] seen-token scoreboard for the repetition penalty —
+    # gathered with the beam parents each step and extended in O(vocab)
+    # (vs the old per-step one-hot rebuild over the whole token buffer)
+    track_seen = gen_cfg.repetition_penalty != 1.0
+    seen = (prompt_seen(ids_f, am_f, vocab) if track_seen
+            else jnp.zeros((b * nb, 1), jnp.bool_.dtype))
     # beam 0 of each group live, the rest -inf so step 1 fans out distinctly;
     # groups evolve independently, so each group gets one live seed beam.
     group_seed = jnp.zeros((nb,), bool).at[jnp.arange(ng) * sub].set(True)
@@ -199,14 +206,14 @@ def beam_search(
     fin_tokens = jnp.full((b, nb, total_len), pad, jnp.int32)
     fin_scores = jnp.full((b, nb), NEG_INF, jnp.float32)
 
-    def beam_step(i, tokens, cache, live_scores, fin_tokens, fin_scores,
+    def beam_step(i, tokens, seen, cache, live_scores, fin_tokens, fin_scores,
                   step_logits):
         """One decode position: pick successors per group, bank EOS
         hypotheses. ``step_logits`` [b*nb, V] are this position's logits."""
         logp = jax.nn.log_softmax(step_logits.astype(jnp.float32), axis=-1)
         logp = process_logits(
-            logp, tokens, i, gen_cfg, prompt_len=prompt_len,
-            token_valid=token_valid,
+            logp, seen if track_seen else None, i, gen_cfg,
+            prompt_len=prompt_len, total_len=total_len,
         )
         if gen_cfg.forced_bos_token_id is not None:
             # force the FIRST generated token (reference
@@ -280,18 +287,23 @@ def beam_search(
         new_tokens = jnp.take(tokens, _flat_parent(parent_all, nb), axis=0)
         new_tokens = jax.lax.dynamic_update_slice(
             new_tokens, tok_all.reshape(b * nb, 1), (0, i))
+        if track_seen:
+            # the scoreboard follows its beam through the reorder, then the
+            # chosen token is folded in
+            seen = jnp.take(seen, _flat_parent(parent_all, nb), axis=0)
+            seen = mark_seen(seen, tok_all.reshape(-1))
         cache = _gather_beams(cache, parent_all, nb, cache_batch_axes,
                               cache_len=cache_len, suffix_start=prompt_len)
-        return new_tokens, cache, new_live, fin_tokens, fin_scores
+        return new_tokens, seen, cache, new_live, fin_tokens, fin_scores
 
     # first decode position consumes the prefill logits
-    tokens, cache, live_scores, fin_tokens, fin_scores = beam_step(
-        jnp.asarray(prompt_len), tokens, cache, live_scores, fin_tokens,
+    tokens, seen, cache, live_scores, fin_tokens, fin_scores = beam_step(
+        jnp.asarray(prompt_len), tokens, seen, cache, live_scores, fin_tokens,
         fin_scores, prefill_logits,
     )
 
     def cond(state):
-        i, _, _, live_scores, _, fin_scores = state
+        i, _, _, _, live_scores, _, fin_scores = state
         # a live beam can still improve on the worst banked hypothesis iff
         # its optimistic final score beats it (HF/t5x early-termination rule);
         # with early_stopping the bank being full ends the search outright.
@@ -313,7 +325,7 @@ def beam_search(
         return (i < total_len) & improvable
 
     def body(state):
-        i, tokens, cache, live_scores, fin_tokens, fin_scores = state
+        i, tokens, seen, cache, live_scores, fin_tokens, fin_scores = state
         cur = jax.lax.dynamic_slice(tokens, (0, i - 1), (b * nb, 1))
         logits, mut = model.apply(
             {"params": params, "cache": cache},
@@ -323,16 +335,17 @@ def beam_search(
             decode=True,
             mutable=["cache"],
         )
-        tokens, cache, live_scores, fin_tokens, fin_scores = beam_step(
-            i, tokens, mut["cache"], live_scores, fin_tokens, fin_scores,
-            logits[:, -1, :],
+        tokens, seen, cache, live_scores, fin_tokens, fin_scores = beam_step(
+            i, tokens, seen, mut["cache"], live_scores, fin_tokens,
+            fin_scores, logits[:, -1, :],
         )
-        return i + 1, tokens, cache, live_scores, fin_tokens, fin_scores
+        return i + 1, tokens, seen, cache, live_scores, fin_tokens, fin_scores
 
-    i, tokens, cache, live_scores, fin_tokens, fin_scores = jax.lax.while_loop(
+    (i, tokens, seen, cache, live_scores, fin_tokens,
+     fin_scores) = jax.lax.while_loop(
         cond, body,
-        (jnp.asarray(prompt_len + 1), tokens, cache, live_scores, fin_tokens,
-         fin_scores),
+        (jnp.asarray(prompt_len + 1), tokens, seen, cache, live_scores,
+         fin_tokens, fin_scores),
     )
 
     # if a batch row banked nothing (no EOS fit in the budget), fall back to

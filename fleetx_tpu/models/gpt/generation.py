@@ -17,7 +17,8 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["GenerationConfig", "generate", "process_logits"]
+__all__ = ["GenerationConfig", "generate", "process_logits", "prompt_seen",
+           "mark_seen"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,11 +53,25 @@ class GenerationConfig:
             kw["max_length"] = d["max_dec_len"]
         if d.get("min_dec_len") is not None:
             kw["min_length"] = d["min_dec_len"]
+        # surface config typos (e.g. `topk` for `top_k`) instead of silently
+        # decoding with defaults; aliases + keys other components read from
+        # the Generation section are not typos (use_cache: the kv-cache loop
+        # is unconditional here; vocab_dir/seed: tokenizer + rng plumbing)
+        aliases = {"max_dec_len", "min_dec_len", "use_cache", "vocab_dir",
+                   "seed"}
+        ignored = sorted(k for k in d if k not in known and k not in aliases)
+        if ignored:
+            from fleetx_tpu.utils.log import logger
+
+            logger.warning(
+                "GenerationConfig.from_config ignoring unknown keys %s "
+                "(known: %s)", ignored, sorted(known | aliases),
+            )
         return cls(**kw)
 
 
-def process_logits(logits, tokens, cur_len, cfg: GenerationConfig, *,
-                   prompt_len=0, token_valid=None):
+def process_logits(logits, seen, cur_len, cfg: GenerationConfig, *,
+                   prompt_len=0, total_len=None):
     """Min-length EOS suppression, repetition penalty, forced EOS (reference
     processor.py: MinLengthLogitsProcessor, RepetitionPenaltyLogitsProcessor,
     ForcedEOSTokenLogitsProcessor).
@@ -64,9 +79,12 @@ def process_logits(logits, tokens, cur_len, cfg: GenerationConfig, *,
     ``cur_len`` is the absolute buffer position; min_length counts DECODED
     tokens, so the EOS ban runs while cur_len < prompt_len + min_length
     (the reference offsets min_length by the input length,
-    single_model.py:1222). ``token_valid`` [b, total_len] marks buffer slots
-    holding real tokens (False for left-pad slots and not-yet-generated
-    tail), keeping the repetition penalty off pad/eos ghosts."""
+    single_model.py:1222). ``seen`` is the [b, vocab] bool scoreboard of
+    tokens already emitted/fed (required iff repetition_penalty != 1.0) —
+    carried through the decode loop and updated in O(vocab) per step via
+    :func:`mark_seen`, replacing the per-step O(total_len * vocab) one-hot
+    rebuild over the whole token buffer. ``total_len`` is the token-buffer
+    length (forced EOS fires at its last slot)."""
     vocab = logits.shape[-1]
     if cfg.min_length > 0:
         logits = jnp.where(
@@ -76,37 +94,56 @@ def process_logits(logits, tokens, cur_len, cfg: GenerationConfig, *,
             logits,
         )
     if cfg.repetition_penalty != 1.0:
-        # penalize every token already actually emitted/fed (not buffer pads)
-        seen_pos = jnp.arange(tokens.shape[1])[None, :] < cur_len
-        if token_valid is not None:
-            seen_pos = seen_pos & token_valid
-        onehot_seen = (
-            jax.nn.one_hot(tokens, vocab, dtype=jnp.bool_.dtype)
-            & seen_pos[..., None]
-        ).any(axis=1)
+        if seen is None:
+            raise ValueError("repetition_penalty != 1.0 needs a seen-token "
+                             "scoreboard (see prompt_seen/mark_seen)")
         penalized = jnp.where(
             logits > 0, logits / cfg.repetition_penalty, logits * cfg.repetition_penalty
         )
-        logits = jnp.where(onehot_seen, penalized, logits)
+        logits = jnp.where(seen, penalized, logits)
     if cfg.forced_eos_token_id is not None:
-        at_last = cur_len >= (tokens.shape[1] - 1)
+        if total_len is None:
+            raise ValueError("forced_eos_token_id needs total_len")
+        at_last = cur_len >= (total_len - 1)
         forced = jnp.full_like(logits, -1e9).at[:, cfg.forced_eos_token_id].set(0.0)
         logits = jnp.where(at_last, forced, logits)
     return logits
 
 
+def prompt_seen(input_ids, attention_mask, vocab: int):
+    """[b, vocab] bool scoreboard of the tokens each prompt row actually
+    contains (left-pad slots excluded). One O(prompt_len * vocab) pass at
+    prefill; decode steps then extend it with :func:`mark_seen`."""
+    onehot = jax.nn.one_hot(input_ids, vocab, dtype=jnp.bool_.dtype)
+    return (onehot & attention_mask.astype(bool)[..., None]).any(axis=1)
+
+
+def mark_seen(seen, tok):
+    """Fold one sampled token [b] into the [b, vocab] scoreboard — O(vocab)
+    per step vs the O(total_len * vocab) rebuild it replaces."""
+    return seen | jax.nn.one_hot(tok, seen.shape[-1], dtype=jnp.bool_.dtype)
+
+
 def right_size_decode_cache(model, total_len: int):
     """(model, cache_len) with the kv cache sized to the decode span.
 
-    Attention streams the whole cache every step, so a 1024-position cache
-    for a 256-token decode would 4x the per-step HBM traffic; unless the
-    caller preset ``decode_cache_len``, clone the model with the cache
-    capped at ``total_len``. A preset that cannot hold the decode raises —
-    an undersized cache would silently clamp writes to the last slot and
-    corrupt the output."""
+    The dense fallback streams the whole cache every step, so a
+    1024-position cache for a 256-token decode would 4x its per-step HBM
+    traffic (the flash-decode kernel reads only the live prefix, but a
+    right-sized buffer still saves HBM and beam-reorder traffic); unless
+    the caller preset ``decode_cache_len``, clone the model with the cache
+    capped at ``total_len`` (rounded up to the flash kernel's 8-row tile).
+    A preset that cannot hold the decode raises — an undersized cache
+    would silently clamp writes to the last slot and corrupt the output."""
     if model.cfg.decode_cache_len is None:
+        cache_len = total_len
+        if model.cfg.use_flash_attention:
+            # round up to the flash-decode kernel's 8-row KV tile so the
+            # Pallas fast path engages for any prompt/gen split; the kernel
+            # never reads past cache_index, so the extra slots cost nothing
+            cache_len += -cache_len % 8
         model = model.clone(
-            cfg=dataclasses.replace(model.cfg, decode_cache_len=total_len))
+            cfg=dataclasses.replace(model.cfg, decode_cache_len=cache_len))
     cache_len = model.cfg.decode_cache_len
     if cache_len < total_len:
         raise ValueError(
@@ -116,21 +153,63 @@ def right_size_decode_cache(model, total_len: int):
     return model, cache_len
 
 
+def _top_p_cutoff_bisect(logits, top_p: float, iters: int = 40):
+    """Probability threshold t such that keeping {prob >= t} matches the
+    smallest descending-sorted prefix with cumulative prob >= top_p.
+
+    Bisection over the threshold: each step is one O(vocab) masked-sum VPU
+    pass, replacing the O(vocab log vocab) full sort (TPU sorts lower to
+    sorting networks — the dominant per-step scalar cost at GPT vocab
+    sizes). The returned t always satisfies mass({prob >= t}) >= top_p, so
+    the kept set is never too small and always contains the argmax; at
+    float32 resolution near-tied probabilities at the cutoff may keep a
+    tie the sort-based version would have dropped (measure-zero for real
+    logits, and sampling is stochastic there anyway)."""
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    def bisect(_, bounds):
+        lo, hi = bounds
+        mid = 0.5 * (lo + hi)
+        mass = jnp.sum(jnp.where(probs >= mid, probs, 0.0), axis=-1,
+                       keepdims=True)
+        keep = mass >= top_p
+        return jnp.where(keep, mid, lo), jnp.where(keep, hi, mid)
+
+    lo = jnp.zeros((logits.shape[0], 1), jnp.float32)
+    hi = jnp.full((logits.shape[0], 1), 1.1, jnp.float32)  # mass(>=1.1) == 0
+    lo, _ = jax.lax.fori_loop(0, iters, bisect, (lo, hi))
+    return probs, lo
+
+
 def _sample(logits, rng, cfg: GenerationConfig):
     if cfg.decode_strategy == "greedy":
         return jnp.argmax(logits, axis=-1)
     logits = logits / jnp.maximum(cfg.temperature, 1e-6)
-    if cfg.top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -cfg.top_k][:, None]
-        logits = jnp.where(logits < kth, -1e9, logits)
-    if cfg.top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # smallest set with cumulative prob >= top_p; always keep the best
-        cutoff_idx = jnp.sum(cum < cfg.top_p, axis=-1, keepdims=True)
-        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
-        logits = jnp.where(logits < cutoff, -1e9, logits)
+    vocab = logits.shape[-1]
+    # clamp: top_k >= vocab keeps the whole distribution (the previous
+    # full-sort indexing crashed on [:, -top_k] out of range)
+    top_k = min(cfg.top_k, vocab)
+    if 0 < top_k < vocab:
+        # one partial sort serves both filters: lax.top_k streams the vocab
+        # once; the old path ran TWO full jnp.sort calls over [b, vocab]
+        vals = jax.lax.top_k(logits, top_k)[0]  # descending [b, top_k]
+        logits = jnp.where(logits < vals[:, -1:], -1e9, logits)
+        if cfg.top_p < 1.0:
+            # top-p inside the top-k survivors: the masked tail underflows
+            # to exactly 0 probability, so softmax over `vals` equals the
+            # full filtered softmax and the same partial sort is reused
+            probs = jax.nn.softmax(vals, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            cutoff_idx = jnp.minimum(
+                jnp.sum(cum < cfg.top_p, axis=-1, keepdims=True), top_k - 1
+            )
+            cutoff = jnp.take_along_axis(vals, cutoff_idx, axis=-1)
+            logits = jnp.where(logits < cutoff, -1e9, logits)
+    elif cfg.top_p < 1.0:
+        # top_k off (or clamped to the whole vocab, a no-op filter): no
+        # partial sort to piggyback on — bisect the probability threshold
+        probs, thresh = _top_p_cutoff_bisect(logits, cfg.top_p)
+        logits = jnp.where(probs >= thresh, logits, -1e9)
     return jax.random.categorical(rng, logits, axis=-1)
 
 
@@ -184,11 +263,6 @@ def generate(
          jnp.ones((b, cache_len - prompt_len), bool)], axis=1,
     )
     kv_mask = kv_valid[:, None, None, :]  # [b, 1, 1(q), cache_len(kv)]
-    # buffer-slot validity for the repetition penalty
-    token_valid = jnp.concatenate(
-        [attention_mask.astype(bool),
-         jnp.ones((b, total_len - prompt_len), bool)], axis=1,
-    )
 
     # static token buffer
     tokens = jnp.full((b, total_len), gen_cfg.pad_token_id, jnp.int32)
@@ -218,21 +292,32 @@ def generate(
         mutable=["cache"],
     )
     cache = mut["cache"]
+    vocab = logits.shape[-1]
+    # repetition penalty reads a [b, vocab] seen-token scoreboard updated in
+    # O(vocab) per step (mark_seen) instead of rebuilding a one-hot over the
+    # whole [b, total_len] buffer every iteration; a 1-element dummy rides
+    # the loop state when the penalty is off
+    track_seen = gen_cfg.repetition_penalty != 1.0
+    seen = (prompt_seen(input_ids.astype(jnp.int32), attention_mask, vocab)
+            if track_seen else jnp.zeros((b, 1), jnp.bool_.dtype))
     rng, step_rng = jax.random.split(rng)
     next_logits = process_logits(
-        logits[:, -1, :], tokens, jnp.asarray(prompt_len), gen_cfg,
-        prompt_len=prompt_len, token_valid=token_valid,
+        logits[:, -1, :], seen if track_seen else None,
+        jnp.asarray(prompt_len), gen_cfg, prompt_len=prompt_len,
+        total_len=total_len,
     )
     next_tok = _sample(next_logits, step_rng, gen_cfg).astype(jnp.int32)
     tokens = jax.lax.dynamic_update_slice(tokens, next_tok[:, None], (0, prompt_len))
+    if track_seen:
+        seen = mark_seen(seen, next_tok)
     finished = next_tok == gen_cfg.eos_token_id
 
     def cond(state):
-        i, _, _, finished, _ = state
+        i, _, _, _, finished, _ = state
         return (i < total_len) & ~jnp.all(finished)
 
     def body(state):
-        i, tokens, cache, finished, rng = state
+        i, tokens, seen, cache, finished, rng = state
         cur = jax.lax.dynamic_slice(tokens, (0, i - 1), (b, 1))
         logits, mut = model.apply(
             {"params": params, "cache": cache},
@@ -244,15 +329,19 @@ def generate(
         )
         cache = mut["cache"]
         rng, step_rng = jax.random.split(rng)
-        nl = process_logits(logits[:, -1, :], tokens, i, gen_cfg,
-                            prompt_len=prompt_len, token_valid=token_valid)
+        nl = process_logits(logits[:, -1, :], seen if track_seen else None,
+                            i, gen_cfg, prompt_len=prompt_len,
+                            total_len=total_len)
         tok = _sample(nl, step_rng, gen_cfg).astype(jnp.int32)
         tok = jnp.where(finished, gen_cfg.pad_token_id, tok)
         tokens = jax.lax.dynamic_update_slice(tokens, tok[:, None], (0, i))
+        if track_seen:
+            seen = mark_seen(seen, tok)
         finished = finished | (tok == gen_cfg.eos_token_id)
-        return i + 1, tokens, cache, finished, rng
+        return i + 1, tokens, seen, cache, finished, rng
 
-    _, tokens, _, _, _ = jax.lax.while_loop(
-        cond, body, (jnp.asarray(prompt_len + 1), tokens, cache, finished, rng)
+    _, tokens, _, _, _, _ = jax.lax.while_loop(
+        cond, body,
+        (jnp.asarray(prompt_len + 1), tokens, seen, cache, finished, rng),
     )
     return tokens

@@ -188,8 +188,26 @@ class SelfAttention(nn.Module):
 
         causal = True
         if decode:
-            k, v, attn_mask = self._update_cache(k, v, attn_mask)
+            kv_pad_mask = attn_mask  # pre-causal-merge mask: left-pad layout
+            k, v, attn_mask, decode_end = self._update_cache(k, v, attn_mask)
             causal = False  # the cache mask encodes absolute-position causality
+            if decode_end is not None and self._flash_decode_ok(
+                kv_pad_mask, k.shape[1], deterministic
+            ):
+                # Single-query fast path: the Pallas flash-decode kernel reads
+                # only the KV blocks inside [starts, cache_index) — per-step
+                # HBM traffic scales with the decoded prefix, not the cache
+                # capacity (fleetx_tpu/ops/pallas/decode_attention.py).
+                from fleetx_tpu.ops.pallas.decode_attention import (
+                    flash_decode_attention,
+                )
+
+                out = flash_decode_attention(
+                    q, k, v, end=decode_end,
+                    starts=self._pad_starts(kv_pad_mask, q.shape[0]),
+                )
+                out = checkpoint_name(out, "core_attn_out")
+                return self._out_proj(out)
 
         if cfg.cp_degree > 1 and not decode:
             # Ring attention: sequence stays sharded over the cp axis; KV
@@ -226,7 +244,11 @@ class SelfAttention(nn.Module):
             dropout_rate=cfg.attention_probs_dropout_prob,
             dropout_rng=dropout_rng,
             deterministic=deterministic,
-            use_flash=cfg.use_flash_attention and not decode,
+            # decode steps that miss the flash-decode fast path (prefill,
+            # custom masks) land here; causal_attention's own shape checks
+            # route them to the XLA path, so the flag no longer needs the
+            # `and not decode` guard
+            use_flash=cfg.use_flash_attention,
             # pp>1 applies stages under nn.vmap; a nested shard_map there
             # would fight the stage sharding (parallel/pipeline.py)
             mesh_shard=cfg.pp_degree == 1,
@@ -243,7 +265,13 @@ class SelfAttention(nn.Module):
         """Incremental decode: append this step's k/v at cache_index and
         build the absolute-position causal mask (query i at absolute position
         start+i may see cache positions <= start+i). Cache layout
-        [batch, max_len, heads, head_dim]."""
+        [batch, max_len, heads, head_dim].
+
+        Returns ``(k, v, attn_mask, decode_end)``: ``decode_end`` is the
+        number of live cache positions after this step's write (the
+        single-query flash-decode kernel's upper bound) — None during init
+        and for multi-token (prefill) calls, where the fast path does not
+        apply."""
         is_init = not self.has_variable("cache", "cached_key")
         b, s, nh, hd = k.shape
         max_len = (self.cfg.decode_cache_len
@@ -256,11 +284,14 @@ class SelfAttention(nn.Module):
             "cache", "cached_value", jnp.zeros, (b, max_len, nh, hd), v.dtype
         )
         idx = self.variable("cache", "cache_index", lambda: jnp.array(0, jnp.int32))
+        decode_end = None
         if not is_init:
             start = idx.value
             ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, start, 0, 0))
             cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, start, 0, 0))
             idx.value = start + s
+            if s == 1:
+                decode_end = idx.value
             k, v = ck.value, cv.value
             q_pos = start + jnp.arange(s)  # absolute positions of the queries
             k_pos = jnp.arange(max_len)
@@ -270,7 +301,58 @@ class SelfAttention(nn.Module):
                 if attn_mask is None
                 else (attn_mask.astype(bool) & causal)
             )
-        return k, v, attn_mask
+        return k, v, attn_mask, decode_end
+
+    def _flash_decode_ok(self, kv_pad_mask, cache_len: int,
+                         deterministic: bool) -> bool:
+        """Static dispatch check for the single-query flash-decode path.
+
+        The kernel handles exactly the generation-loop mask shape: an
+        optional [b, 1, 1, cache_len] key-validity mask whose False slots
+        are the contiguous left-pad prefix (generate()/beam_search() build
+        exactly this). Anything else — arbitrary masks, attention dropout,
+        untileable cache lengths, an ambient multi-device mesh (the bare
+        Pallas call would make GSPMD replicate the sharded operands) —
+        falls back to the dense XLA path."""
+        cfg = self.cfg
+        if not cfg.use_flash_attention:
+            return False
+        if not (deterministic or cfg.attention_probs_dropout_prob == 0.0):
+            return False
+        if kv_pad_mask is not None and (
+            kv_pad_mask.ndim != 4
+            or kv_pad_mask.shape[1] != 1
+            or kv_pad_mask.shape[2] != 1
+            or kv_pad_mask.shape[3] != cache_len
+        ):
+            return False
+        from fleetx_tpu.ops.pallas.decode_attention import decode_flash_supported
+        from fleetx_tpu.parallel.mesh import ambient_mesh
+
+        mesh = ambient_mesh()
+        if mesh is not None and mesh.size > 1:
+            return False
+        return decode_flash_supported(cache_len)
+
+    @staticmethod
+    def _pad_starts(kv_pad_mask, batch: int):
+        """Per-row first live cache position from the [b, 1, 1, cache_len]
+        key-validity mask; None mask = no padding.
+
+        The window the kernel attends is [starts, cache_index), so the mask
+        contract is: False slots form a contiguous left-pad prefix (the
+        generation loop's layout), with any further False slots only at
+        positions the cache index has not reached yet (a right-padded
+        layout is therefore also exact). Taking the FIRST True — rather
+        than counting all False slots — keeps right-padded masks correct;
+        arbitrary interior holes are outside the fast path's contract
+        (docs/PERFORMANCE.md) and cannot be detected at trace time."""
+        if kv_pad_mask is None:
+            return None
+        starts = jnp.argmax(
+            kv_pad_mask.astype(bool)[:, 0, 0, :], axis=-1
+        ).astype(jnp.int32)
+        return jnp.broadcast_to(starts, (batch,))
 
 
 class MLP(nn.Module):

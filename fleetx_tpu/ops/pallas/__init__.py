@@ -1,1 +1,1 @@
-"""Pallas TPU kernels (flash attention)."""
+"""Pallas TPU kernels (flash attention, flash decode, fused CE loss)."""

@@ -52,7 +52,9 @@ def _interpret() -> bool:
 
 def _params_2d():
     # j (vocab / token stream) is the innermost scratch-carrying axis
-    return pltpu.CompilerParams(dimension_semantics=("parallel", "arbitrary"))
+    from fleetx_tpu.ops.pallas.flash_attention import CompilerParams
+
+    return CompilerParams(dimension_semantics=("parallel", "arbitrary"))
 
 
 def fit_vocab_block(v: int, want: int = 512):
@@ -344,6 +346,7 @@ def fused_linear_ce(hidden: jax.Array, emb: jax.Array,
         )
 
     from fleetx_tpu.parallel.mesh import ambient_mesh
+    from fleetx_tpu.parallel.mesh import shard_map as _shard_map
 
     mesh = ambient_mesh()
     n_data, n_mp = 1, 1
@@ -380,7 +383,7 @@ def fused_linear_ce(hidden: jax.Array, emb: jax.Array,
                     block_t, block_v_loc)
                 return lse1[None, :], lab1[None, :]
 
-            fn = jax.shard_map(
+            fn = _shard_map(
                 body,
                 mesh=mesh,
                 in_specs=(P(data_axes, None), P("mp", None), P(data_axes)),
@@ -390,7 +393,7 @@ def fused_linear_ce(hidden: jax.Array, emb: jax.Array,
             lse_stack, lab_stack = fn(hidden, emb, labels)  # [mp, n]
             return (jax.scipy.special.logsumexp(lse_stack, axis=0)
                     - lab_stack.sum(axis=0))
-        fn = jax.shard_map(
+        fn = _shard_map(
             # custom_vjp statics must stay positional
             lambda h_, w_, l_: _fused_ce(h_, w_, l_, block_t, block_v),
             mesh=mesh,

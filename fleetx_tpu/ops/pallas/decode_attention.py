@@ -1,0 +1,257 @@
+"""Flash-decode: Pallas single-query attention over the kv cache.
+
+The serving-side sibling of ops/pallas/flash_attention.py. During kv-cache
+generation every step is one query token attending to the cache prefix
+written so far — but the dense fallback streams the ENTIRE
+``decode_cache_len`` buffer through HBM per step per layer, so a 1024-slot
+cache costs 4x the traffic of a 256-token decode span. Decode attention is
+purely bandwidth-bound (one [1, d] query does ~2*d FLOPs per cached key),
+so HBM bytes touched IS the latency; this kernel makes those bytes scale
+with the live prefix instead of the cache capacity.
+
+Same idioms as the training kernel: online softmax (never materializes the
+[1, cache_len] score row in HBM), major-block K/V streaming with an
+in-kernel ``fori_loop`` over compute tiles, env-tunable block sizes, and
+``interpret=True`` off-TPU so CPU tests execute the real kernel math.
+
+What's different from the training kernel:
+- q_len == 1: no causal structure inside a step. The valid key window per
+  batch row is the contiguous ``[starts[b], end)`` — ``starts`` are the
+  left-pad counts of the prompt (pads sit at the FRONT of the cache; see
+  generation.py kv layout) and ``end`` is ``cache_index`` after this
+  step's write (the query's own position + 1).
+- ``end``/``starts`` are TRACED values (the loop counter of the decode
+  ``while_loop``), so the dead-block skip cannot be a Python-level grid
+  trim. They are fed through ``pltpu.PrefetchScalarGridSpec`` scalar
+  prefetch: the K/V index maps clamp the streamed block index into the
+  live ``[first, last]`` major-block range, so grid steps outside it
+  repeat a resident index (NO HBM DMA) and ``pl.when`` retires them
+  without compute. Per-step traffic is ceil(end/major) blocks — the
+  tokens decoded so far — not ``cache_len``.
+- forward-only: decode never differentiates, so there is no VJP, no lse
+  output, and no dropout plumbing.
+"""
+
+from __future__ import annotations
+
+import functools
+import os as _os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from fleetx_tpu.ops.pallas.flash_attention import (
+    NEG_INF,
+    CompilerParams,
+    _env_block,
+    _interpret,
+    _mm_dtype,
+)
+
+__all__ = ["flash_decode_attention", "decode_flash_supported", "fit_decode_blocks"]
+
+# Cache-dim tile sizes, swept independently of the training kernel's
+# (decode tiles trade MXU shape for DMA granularity — the query side is one
+# row, so there is no q-block dimension to balance against).
+DEFAULT_DECODE_BLOCK_K = _env_block("FLEETX_DECODE_BLOCK_K", 256)
+# rows of K and V resident in VMEM per grid step (the HBM->VMEM DMA unit)
+DEFAULT_DECODE_BLOCK_MAJOR = _env_block("FLEETX_DECODE_BLOCK_MAJOR", 1024)
+
+
+def fit_decode_blocks(cache_len: int,
+                      want_k: Optional[int] = None,
+                      want_major: Optional[int] = None):
+    """(block_k, major) tiling ``cache_len``, or (None, None) if no 8-row
+    tile divides it. Largest divisor <= the requested sizes, mirroring
+    flash_attention.fit_blocks. Trace-time Python only."""
+    want_k = DEFAULT_DECODE_BLOCK_K if want_k is None else want_k
+    want_major = DEFAULT_DECODE_BLOCK_MAJOR if want_major is None else want_major
+    want_k = min(want_k, cache_len)
+    block_k = next(
+        (bk for bk in range(want_k - want_k % 8, 7, -8)
+         if cache_len % bk == 0), None
+    )
+    if block_k is None:
+        return None, None
+    n = cache_len // block_k
+    t = min(n, max(want_major // block_k, 1))
+    while n % t:
+        t -= 1
+    return block_k, t * block_k
+
+
+def decode_flash_supported(cache_len: int) -> bool:
+    """Static dispatch check for the model layer: the cache tiles, and we
+    are on a real TPU (or the interpreter is explicitly forced — CPU decode
+    parity tests and the multichip dryrun set FLEETX_FORCE_FLASH=1)."""
+    block_k, _ = fit_decode_blocks(cache_len)
+    return block_k is not None and (
+        jax.default_backend() in ("tpu", "axon")
+        or _os.environ.get("FLEETX_FORCE_FLASH") == "1"
+    )
+
+
+def _decode_kernel(starts_ref, ends_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, block_k: int, major: int,
+                   scale: float):
+    """Grid step (batch bi, head hi, K/V major block jm): online-softmax
+    update of the single query row against the live tiles of the resident
+    major block.
+
+    Every tile intersecting ``[start, end)`` runs masked — with one query
+    row the mask is a [1, block_k] compare, noise next to the two dots, so
+    the training kernel's free/masked two-phase walk buys nothing here."""
+    bi = pl.program_id(0)
+    jm = pl.program_id(2)
+    start = starts_ref[bi]
+    end = ends_ref[bi]
+    first_jm = start // major
+    last_jm = (end - 1) // major
+    tiles = major // block_k
+
+    @pl.when(jm == first_jm)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    @pl.when((jm >= first_jm) & (jm <= last_jm))
+    def _step():
+        mm_dt = _mm_dtype(q_ref.dtype)
+        q = q_ref[:].astype(mm_dt)  # [1, d]
+        # local tile range intersecting the valid window [start, end)
+        t_lo = jnp.clip((start - jm * major) // block_k, 0, tiles)
+        t_hi = jnp.clip(
+            (end - jm * major + block_k - 1) // block_k, 0, tiles
+        )
+
+        def body(t, carry):
+            m, l, acc = carry
+            k_blk = k_ref[pl.ds(t * block_k, block_k), :].astype(mm_dt)
+            v_blk = v_ref[pl.ds(t * block_k, block_k), :].astype(mm_dt)
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [1, block_k]
+            k_row = (jm * major + t * block_k
+                     + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
+            s = jnp.where((k_row >= start) & (k_row < end), s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            # keep p exactly 0 on masked lanes so poisoned/unwritten cache
+            # slots inside a boundary tile cannot leak through p @ v
+            p = jnp.where(s > NEG_INF / 2, p, 0.0)
+            alpha = jnp.exp(m - m_new)
+            l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = alpha * acc + jax.lax.dot_general(
+                p.astype(mm_dt), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc_new
+
+        carry = (m_scr[:], l_scr[:], acc_scr[:])
+        m, l, acc = jax.lax.fori_loop(t_lo, t_hi, body, carry)
+        m_scr[:] = m
+        l_scr[:] = l
+        acc_scr[:] = acc
+
+    @pl.when(jm == last_jm)
+    def _finalize():
+        l = l_scr[:]
+        # the window always holds the query's own position, so l > 0; the
+        # guard keeps a (contract-violating) empty window finite, not NaN
+        l_safe = jnp.where(l > 0.0, l, 1.0)
+        o_ref[:] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _kv_index_map(major: int):
+    """K/V major-block index for grid step (bi, hi, jm): clamped into the
+    live [first, last] range of THIS batch row, so dead steps repeat a
+    resident block index and trigger no DMA — the per-step HBM traffic is
+    what scales with the decoded prefix. Blocks index the NATIVE
+    [b, cache_len, h, d] cache layout: a [b*h, ...] repack would stream
+    the entire cache through HBM once per step just to transpose it,
+    costing more than the dense path it replaces."""
+
+    def index_map(bi, hi, jm, starts_ref, ends_ref):
+        first = starts_ref[bi] // major
+        last = (ends_ref[bi] - 1) // major
+        return bi, jnp.clip(jm, first, last), hi, 0
+
+    return index_map
+
+
+def _q_index_map(bi, hi, jm, starts_ref, ends_ref):
+    return bi, 0, hi, 0
+
+
+def flash_decode_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    end: jax.Array,
+    starts: Optional[jax.Array] = None,
+    block_k: Optional[int] = None,
+    block_major: Optional[int] = None,
+) -> jax.Array:
+    """Single-query attention against the kv cache, [b, 1, h, d] layout.
+
+    ``k``/``v`` are the FULL cache buffers [b, cache_len, h, d]; ``end``
+    (traced int32 scalar or [b]) is the number of live cache positions —
+    ``cache_index`` after this step's write — and ``starts`` ([b] int32,
+    optional) the per-row first valid position (left-pad count). Row b
+    attends exactly the window [starts[b], end). No scaling/softmax state
+    leaves the kernel; output dtype follows ``q``.
+
+    ``cache_len`` must be a multiple of 8 (checked; callers pre-screen with
+    :func:`decode_flash_supported` and take the XLA path otherwise).
+    """
+    b, sq, h, d = q.shape
+    if sq != 1:
+        raise ValueError(f"flash decode is single-query (q_len={sq})")
+    cache_len = k.shape[1]
+    block_k, major = fit_decode_blocks(cache_len, block_k, block_major)
+    if block_k is None:
+        raise ValueError(
+            f"cache_len {cache_len} not tileable (must be a multiple of 8)"
+        )
+    n_major = cache_len // major
+
+    ends_b = jnp.broadcast_to(jnp.asarray(end, jnp.int32), (b,))
+    starts_b = (jnp.zeros((b,), jnp.int32) if starts is None
+                else starts.astype(jnp.int32))
+
+    # grid (b, h, majors) over the NATIVE [b, s, h, d] layout — no
+    # [b*h, s, d] repack, which would itself stream the full cache
+    kernel = functools.partial(
+        _decode_kernel, block_k=block_k, major=major, scale=1.0 / (d**0.5)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, n_major),
+        in_specs=[
+            pl.BlockSpec((None, 1, None, d), _q_index_map),
+            pl.BlockSpec((None, major, None, d), _kv_index_map(major)),
+            pl.BlockSpec((None, major, None, d), _kv_index_map(major)),
+        ],
+        out_specs=pl.BlockSpec((None, 1, None, d), _q_index_map),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),   # running max m
+            pltpu.VMEM((1, 1), jnp.float32),   # running normalizer l
+            pltpu.VMEM((1, d), jnp.float32),   # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, 1, h, d), q.dtype),
+        compiler_params=CompilerParams(
+            # the major-block axis carries the online-softmax scratch state
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=_interpret(),
+    )(starts_b, ends_b, q, k, v)

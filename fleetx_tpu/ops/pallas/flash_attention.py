@@ -72,6 +72,12 @@ __all__ = ["flash_attention"]
 
 import os as _os
 
+# jax renamed pltpu.TPUCompilerParams -> pltpu.CompilerParams; accept either
+# so the kernels load on both sides of the rename
+CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 
 def _env_block(name: str, default: int) -> int:
     """Env-tunable block size; validated once at import (ADVICE r3 #4:
@@ -131,7 +137,7 @@ def _interpret() -> bool:
 def _compiler_params():
     # innermost grid axis carries the online-softmax scratch state, so it
     # must stay sequential; the outer two can partition over megacores
-    return pltpu.CompilerParams(
+    return CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary")
     )
 
@@ -933,7 +939,9 @@ def _sharded_flash(mesh, q, k, v, seed, kv_lens, block_q, block_k,
                       dropout_rate, causal)
 
     spec = P(data_axes or None, None, head_axis, None)
-    fn = jax.shard_map(
+    from fleetx_tpu.parallel.mesh import shard_map
+
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(spec, spec, spec, P(None), P(data_axes or None)),

@@ -91,7 +91,9 @@ def branch_parallel2(
 
     replicated = jax.tree.map(lambda _: P(), (args0, args1))
     out_spec = jax.tree.map(lambda _: P(), (out0_sd, out1_sd))
-    return jax.shard_map(
+    from fleetx_tpu.parallel.mesh import shard_map
+
+    return shard_map(
         body, mesh=mesh, in_specs=replicated, out_specs=out_spec,
         check_vma=False,
     )(args0, args1)

@@ -39,6 +39,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from fleetx_tpu.ops.attention import NEG_INF
 
+
+def _axis_size(axis_name: str) -> jax.Array:
+    """Mapped-axis size across the jax API move: ``lax.axis_size`` where it
+    exists, else the classic trace-time-constant ``psum(1, axis)`` idiom."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
 __all__ = [
     "ring_attention",
     "ring_self_attention",
@@ -81,7 +89,7 @@ def _ring_attention_local(
     ``axis_name``. q, k, v: [b, 2, s_blk, h, d] with the two zig-zag blocks
     stacked on dim 1 (block 0 = "early" slice, block 1 = "late" slice).
     """
-    cp = lax.axis_size(axis_name)
+    cp = _axis_size(axis_name)
     me = lax.axis_index(axis_name)
     b, two, s_blk, h, d = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
@@ -200,7 +208,7 @@ def _ring_flash_fwd(q, k, v, seed, axis_name, causal, dropout_rate,
                     shard_info):
     from fleetx_tpu.ops.pallas.flash_attention import block_fwd_lse
 
-    cp = lax.axis_size(axis_name)
+    cp = _axis_size(axis_name)
     me = lax.axis_index(axis_name)
     b, _, s_blk, h, d = q.shape
     s_tot = 2 * cp * s_blk
@@ -269,7 +277,7 @@ def _ring_flash_bwd(axis_name, causal, dropout_rate, shard_info, res, g):
     from fleetx_tpu.ops.pallas.flash_attention import block_dkv, block_dq
 
     q, k, v, out, lse_all, seed = res
-    cp = lax.axis_size(axis_name)
+    cp = _axis_size(axis_name)
     me = lax.axis_index(axis_name)
     b, _, s_blk, h, d = q.shape
     s_tot = 2 * cp * s_blk
@@ -552,7 +560,9 @@ def ring_self_attention(
         )
 
     spec = P(batch_axes, cp_axis, head_axis, None)
-    fn = jax.shard_map(
+    from fleetx_tpu.parallel.mesh import shard_map
+
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(spec, spec, spec, P(None)),

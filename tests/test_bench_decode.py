@@ -25,4 +25,12 @@ def test_decode_records_schema(monkeypatch, eight_devices):
         assert r["unit"] == "tokens/s"
         assert np.isfinite(r["value"]) and r["value"] > 0
         assert r["detail"]["gen_len"] == 8
+        # phase breakdown: prefill latency and steady-state decode cost are
+        # reported separately so serving wins attribute to the right phase
+        assert np.isfinite(r["detail"]["prefill_ms"])
+        assert r["detail"]["prefill_ms"] > 0
+        assert np.isfinite(r["detail"]["decode_ms_per_token"])
+        assert r["detail"]["decode_ms_per_token"] >= 0
     assert recs[2]["detail"]["num_beams"] == 4
+    # prefill is measured per batch, shared across modes
+    assert (recs[0]["detail"]["prefill_ms"] == recs[2]["detail"]["prefill_ms"])

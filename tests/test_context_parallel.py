@@ -11,6 +11,7 @@ import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fleetx_tpu.ops.attention import causal_attention
+from fleetx_tpu.parallel.mesh import shard_map
 from fleetx_tpu.parallel.context_parallel import (
     ring_attention,
     ring_self_attention,
@@ -51,7 +52,7 @@ def test_ring_matches_reference(eight_devices, cp, causal):
     qz, kz, vz = (zigzag_split(x, cp) for x in (q, k, v))
     spec = P(None, "cp", None, None)
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda a, b, c: ring_attention(a, b, c, causal=causal),
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -96,7 +97,7 @@ def test_ring_gradients_match(eight_devices):
     def ref_loss(q, k, v):
         return (causal_attention(q, k, v, use_flash=False) ** 2).sum()
 
-    ring = jax.shard_map(
+    ring = shard_map(
         lambda a, b, c: ring_attention(a, b, c, causal=True),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -177,6 +177,112 @@ def test_from_config_maps_dec_len_keys():
     assert cfg.max_length == 11 and cfg.min_length == 3 and cfg.top_k == 5
 
 
+def test_from_config_warns_on_unknown_keys(caplog):
+    """Config typos (`topk` for `top_k`) must surface as a warning listing
+    the ignored keys instead of silently degrading decode quality."""
+    import logging
+
+    from fleetx_tpu.utils.log import logger as fleetx_logger
+
+    fleetx_logger.propagate = True  # caplog listens on the root logger
+    try:
+        with caplog.at_level(logging.WARNING, logger="fleetx_tpu"):
+            cfg = GenerationConfig.from_config({"topk": 5, "max_length": 7})
+    finally:
+        fleetx_logger.propagate = False
+    assert cfg.top_k == 0 and cfg.max_length == 7
+    assert "topk" in caplog.text and "ignoring unknown keys" in caplog.text
+
+
+def test_from_config_known_keys_warn_free(caplog):
+    import logging
+
+    from fleetx_tpu.utils.log import logger as fleetx_logger
+
+    fleetx_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING, logger="fleetx_tpu"):
+            GenerationConfig.from_config({"max_dec_len": 9, "top_p": 0.9})
+    finally:
+        fleetx_logger.propagate = False
+    assert caplog.text == ""
+
+
+def test_top_k_clamped_to_vocab(model_and_params):
+    """top_k >= vocab must behave exactly like an unfiltered distribution
+    (the old full-sort indexing misbehaved on [:, -top_k])."""
+    model, params = model_and_params
+    prompt = jnp.asarray([[4, 9, 2]], jnp.int32)
+    rng = jax.random.PRNGKey(11)
+    base = GenerationConfig(max_length=6, min_length=6,
+                            decode_strategy="sampling", eos_token_id=10**6,
+                            pad_token_id=96)
+    import dataclasses
+
+    huge = dataclasses.replace(base, top_k=10 * 97)   # >> vocab
+    exact = dataclasses.replace(base, top_k=0)        # no filter at all
+    out_huge = np.asarray(generate(model, params, prompt, huge, rng=rng))
+    out_exact = np.asarray(generate(model, params, prompt, exact, rng=rng))
+    np.testing.assert_array_equal(out_huge, out_exact)
+
+
+def test_top_p_bisect_matches_sorted_reference():
+    """The sort-free top-p threshold must keep exactly the smallest
+    descending-sorted prefix with cumulative prob >= top_p."""
+    from fleetx_tpu.models.gpt.generation import _top_p_cutoff_bisect
+
+    rng = np.random.RandomState(5)
+    logits = jnp.asarray(rng.randn(8, 257) * 3.0, jnp.float32)
+    for top_p in (0.3, 0.9, 0.99):
+        probs, thresh = _top_p_cutoff_bisect(logits, top_p)
+        kept = np.asarray(probs >= thresh)
+        # reference: the old sort-based cutoff
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        ref_probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(ref_probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        ref_kept = np.asarray(logits >= cutoff)
+        np.testing.assert_array_equal(kept, ref_kept,
+                                      err_msg=f"top_p={top_p}")
+        # kept mass always covers top_p; best token always survives
+        mass = np.where(kept, np.asarray(probs), 0.0).sum(axis=-1)
+        assert (mass >= top_p - 1e-6).all()
+        assert kept[np.arange(8), np.asarray(probs).argmax(axis=-1)].all()
+
+
+def test_repetition_penalty_scoreboard(model_and_params):
+    """The O(V) seen-token scoreboard must reproduce the semantics of the
+    old buffer rebuild: penalty>1 discourages repeats of emitted/prompt
+    tokens, and prompt pad slots stay unpenalized."""
+    model, params = model_and_params
+    from fleetx_tpu.models.gpt.generation import (
+        mark_seen,
+        process_logits,
+        prompt_seen,
+    )
+
+    # unit semantics: prompt tokens (minus pads) + marked tokens penalized
+    seen = prompt_seen(jnp.asarray([[96, 5, 7]], jnp.int32),
+                       jnp.asarray([[0, 1, 1]], jnp.int32), 97)
+    seen = mark_seen(seen, jnp.asarray([11], jnp.int32))
+    logits = jnp.ones((1, 97), jnp.float32)
+    cfg = GenerationConfig(repetition_penalty=2.0)
+    out = np.asarray(process_logits(logits, seen, jnp.asarray(3), cfg))
+    assert out[0, 5] == 0.5 and out[0, 7] == 0.5 and out[0, 11] == 0.5
+    assert out[0, 96] == 1.0  # pad slot of the prompt is NOT seen
+    assert out[0, 3] == 1.0
+
+    # end-to-end: the penalized run must still decode deterministically
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    cfg = GenerationConfig(max_length=6, min_length=6,
+                           decode_strategy="greedy", repetition_penalty=1.3,
+                           eos_token_id=10**6, pad_token_id=96)
+    out1 = np.asarray(generate(model, params, prompt, cfg))
+    out2 = np.asarray(generate(model, params, prompt, cfg))
+    np.testing.assert_array_equal(out1, out2)
+
+
 def test_eval_module_scoring(tmp_path):
     from fleetx_tpu.models.language_module_eval import GPTEvalModule
     from fleetx_tpu.utils.config import AttrDict

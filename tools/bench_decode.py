@@ -46,16 +46,66 @@ def _model_345m(max_pos: int):
         hidden_dropout_prob=0.0,
         attention_probs_dropout_prob=0.0,
         fuse_attn_qkv=True,
-        use_flash_attention=False,  # decode is length-1 queries: XLA path
+        # length-1 decode queries route to the Pallas flash-decode kernel
+        # (ops/pallas/decode_attention.py) on TPU; prefill and non-tiling
+        # shapes fall back to the XLA path inside the model
+        use_flash_attention=True,
         dtype=jnp.float32 if _TINY else jnp.bfloat16,
     )
     return GPTForPretraining(cfg)
 
 
+def _prefill_latency_s(model, variables, ids, steps: int) -> float:
+    """Median latency of the jitted prefill alone — the same right-sized
+    cache + masked forward ``generate()`` runs before its decode loop, so
+    ``total - prefill`` isolates the while_loop's steady-state cost."""
+    import jax
+    import jax.numpy as jnp
+
+    from fleetx_tpu.models.gpt.generation import right_size_decode_cache
+
+    b, prompt_len = ids.shape
+    sized, cache_len = right_size_decode_cache(model, prompt_len + GEN_LEN)
+    params = variables["params"] if "params" in variables else variables
+
+    @jax.jit
+    def prefill(params, ids):
+        cache_shapes = jax.eval_shape(
+            lambda: sized.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((b, 1), jnp.int32),
+                jnp.zeros((b, 1), jnp.int32),
+                decode=True,
+            )
+        )["cache"]
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                             cache_shapes)
+        kv_mask = jnp.ones((b, 1, 1, cache_len), bool)
+        pos = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
+        logits, _ = sized.apply(
+            {"params": params, "cache": cache},
+            ids, pos.astype(jnp.int32), kv_mask,
+            decode=True, mutable=["cache"],
+        )
+        return logits
+
+    jax.device_get(prefill(params, ids))  # compile + warmup
+    times = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        jax.device_get(prefill(params, ids))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
 def decode_records(modes=("greedy", "beam"), batches=(1, 8), steps: int = 3):
     """Returns one record per (mode, batch): median-of-``steps`` timed runs
     after a compile warmup. min_length pins the decode length (see below)
-    so random-weight runs can't finish early and inflate tokens/s."""
+    so random-weight runs can't finish early and inflate tokens/s.
+
+    ``detail`` splits the end-to-end time into the prefill latency and the
+    steady-state per-token decode latency so serving wins can be attributed
+    to the right phase (prompt processing vs the kv-cache loop)."""
     import jax
 
     from fleetx_tpu.models.gpt.generation import GenerationConfig, generate
@@ -69,6 +119,13 @@ def decode_records(modes=("greedy", "beam"), batches=(1, 8), steps: int = 3):
     variables = jax.jit(model.init)(
         jax.random.PRNGKey(0), prompt1[:1, :8]
     )
+
+    # prefill cost depends only on the batch (beam search prefills at batch
+    # size too, expanding to beams afterwards) — measure once per batch
+    prefill_s = {
+        b: _prefill_latency_s(model, variables, prompt1[:b], steps)
+        for b in batches
+    }
 
     records = []
     for mode in modes:
@@ -103,6 +160,10 @@ def decode_records(modes=("greedy", "beam"), batches=(1, 8), steps: int = 3):
                 times.append(time.perf_counter() - t0)
             dt = float(np.median(times))
             toks = b * GEN_LEN
+            # steady-state decode: what the while_loop costs once the prompt
+            # is in the cache (clamped at 0 in case of timing noise on very
+            # small runs)
+            decode_s = max(dt - prefill_s[b], 0.0)
             records.append({
                 "metric": f"gpt_345m_decode_{mode}_b{b}",
                 "value": round(toks / dt, 1),
@@ -115,6 +176,8 @@ def decode_records(modes=("greedy", "beam"), batches=(1, 8), steps: int = 3):
                     "num_beams": gen_cfg.num_beams,
                     "latency_s_per_seq": round(dt, 3),
                     "ms_per_token": round(dt / GEN_LEN * 1e3, 2),
+                    "prefill_ms": round(prefill_s[b] * 1e3, 2),
+                    "decode_ms_per_token": round(decode_s / GEN_LEN * 1e3, 2),
                     "device": getattr(jax.devices()[0], "device_kind", "?"),
                 },
             })
