@@ -219,23 +219,25 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
     return rec
 
 
-def _decode_records_subprocess(timeout_s: int):
-    """Serving bench in a CHILD process with a hard timeout, run BEFORE the
+def _child_bench_records(tool: str, label: str, timeout_s: int):
+    """A bench tool in a CHILD process with a hard timeout, run BEFORE the
     parent touches the TPU (the chip is exclusive: two live processes can't
     both hold it, and an in-process compile hang would sink the anchor
-    record — the driver contract is one JSON line, printed at the end)."""
+    record — the driver contract is one JSON line, printed at the end).
+    Serves both serving-side benches: tools/bench_decode.py (one-shot
+    decode throughput) and tools/bench_serving.py (static-vs-continuous
+    batching)."""
     import subprocess
     import sys
 
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(os.path.dirname(
-                os.path.abspath(__file__)), "tools", "bench_decode.py")],
+                os.path.abspath(__file__)), "tools", tool)],
             capture_output=True, text=True, timeout=timeout_s,
         )
     except subprocess.TimeoutExpired:
-        return [{"metric": "gpt_345m_decode",
-                 "error": f"timeout after {timeout_s}s"}]
+        return [{"metric": label, "error": f"timeout after {timeout_s}s"}]
     recs = []
     for line in proc.stdout.splitlines():
         if line.startswith("{"):
@@ -246,11 +248,10 @@ def _decode_records_subprocess(timeout_s: int):
     if proc.returncode != 0:
         # surface the failure even when some modes printed before the crash
         # (partial greedy records must not read as a complete decode bench)
-        recs.append({"metric": "gpt_345m_decode",
+        recs.append({"metric": label,
                      "error": f"rc={proc.returncode}: {proc.stderr[-500:]}"})
     elif not recs:
-        recs = [{"metric": "gpt_345m_decode",
-                 "error": "no records in child stdout"}]
+        recs = [{"metric": label, "error": "no records in child stdout"}]
     return recs
 
 
@@ -279,9 +280,13 @@ def main():
 
     extras = []
     if os.environ.get("BENCH_EXTRA", "1") != "0":
-        # decode first: the child must own the chip before the parent does
-        extras.extend(_decode_records_subprocess(
+        # children first: each must own the chip before the parent does
+        extras.extend(_child_bench_records(
+            "bench_decode.py", "gpt_345m_decode",
             int(os.environ.get("BENCH_DECODE_TIMEOUT", 900))))
+        extras.extend(_child_bench_records(
+            "bench_serving.py", "gpt_345m_serving",
+            int(os.environ.get("BENCH_SERVING_TIMEOUT", 900))))
 
     _acquire_devices_or_die(int(os.environ.get("BENCH_INIT_TIMEOUT", 300)))
 

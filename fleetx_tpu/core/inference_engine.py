@@ -42,6 +42,8 @@ class InferenceEngine:
         self.module = build_module(cfg)
         self.mesh = mesh
         self._forward = None
+        self._serving = None
+        self._gen_calls = 0  # folded into sampling keys: repeat calls differ
         gen = self.cfg.get("Generation") or {}
         self.eos_token_id = int(gen.get("eos_token_id") or 50256)
         logger.info("inference engine: %s from %s", module_name, export_dir)
@@ -92,7 +94,22 @@ class InferenceEngine:
 
     def generate(self, input_ids: np.ndarray, **overrides):
         """Sampling/greedy decode via the exported Generation config
-        (requires the module to be a GPTGenerationModule export)."""
+        (requires the module to be a GPTGenerationModule export).
+
+        Servable requests (greedy/sampling, no repetition penalty / forced
+        EOS, no mesh) delegate to the continuous-batching
+        :class:`~fleetx_tpu.serving.ServingEngine` — same [b, prompt+max]
+        token buffer, but rows retire independently and the engine is
+        shared with any concurrent ``serving_engine()`` traffic pattern;
+        ``FLEETX_SERVING_DELEGATE=0`` forces the legacy one-shot loop.
+        Beam search and penalty requests always run one-shot, sharded over
+        ``self.mesh`` exactly like ``predict()`` when a mesh was given.
+
+        Each call folds a call counter into the sampling key, so repeated
+        sampling requests draw fresh tokens; pass an explicit ``seed``
+        override to pin a reproducible stream instead."""
+        import os
+
         from fleetx_tpu.models.gpt.generation import GenerationConfig, generate
 
         gen_cfg = dict(self.cfg.get("Generation") or {})
@@ -100,10 +117,72 @@ class InferenceEngine:
             gen_cfg.pop("max_dec_len", None)  # explicit override wins
         gen_cfg.update(overrides)
         gcfg = GenerationConfig.from_config(gen_cfg)
-        return generate(
+        base = jax.random.PRNGKey(int(gen_cfg.get("seed") or 0))
+        # an explicit per-call seed means "give me this exact stream";
+        # otherwise each call advances (the seed-reuse fix). seed=None is
+        # NOT a pin — forwarded optionals must still advance.
+        rng = (base if overrides.get("seed") is not None
+               else jax.random.fold_in(base, self._gen_calls))
+        self._gen_calls += 1
+        ids = np.asarray(input_ids)
+        # the serving cache must FIT the request — a too-small
+        # FLEETX_SERVING_CACHE_LEN must fall back to the one-shot loop,
+        # never silently truncate the delegated output
+        max_pos = self.module.nets.cfg.max_position_embeddings
+        serving_cap = min(
+            int(os.environ.get("FLEETX_SERVING_CACHE_LEN", 0) or max_pos),
+            max_pos)
+        if (self.mesh is None
+                and os.environ.get("FLEETX_SERVING_DELEGATE", "1") != "0"
+                and self._servable(gcfg)
+                and ids.shape[-1] + gcfg.max_length <= serving_cap):
+            return self._serving_engine(gcfg).generate_batch(
+                ids, gcfg, rng=rng)
+        run = lambda: generate(  # noqa: E731
             self.module.nets,
             {"params": self.params},
             np.asarray(input_ids),
             gcfg,
-            rng=jax.random.PRNGKey(int(gen_cfg.get("seed") or 0)),
+            rng=rng,
         )
+        if self.mesh is not None:
+            # same contract as predict(): replicated params, dp-sharded
+            # batch, logical-axis rules resolving the model's constraints
+            from flax import linen as nn
+
+            from fleetx_tpu.parallel.mesh import use_mesh
+            from fleetx_tpu.parallel.sharding import make_rules
+
+            with use_mesh(self.mesh), nn.logical_axis_rules(make_rules()):
+                return run()
+        return run()
+
+    @staticmethod
+    def _servable(gcfg) -> bool:
+        """True when the continuous-batching engine covers this request
+        shape (see ServingEngine docstring for the exclusions)."""
+        return (gcfg.decode_strategy in ("greedy", "sampling")
+                and gcfg.repetition_penalty == 1.0
+                and gcfg.forced_eos_token_id is None
+                and gcfg.num_return_sequences == 1)
+
+    def _serving_engine(self, gcfg):
+        # built with the first servable call's config (engine-level
+        # defaults only — generate_batch passes per-call configs anyway)
+        if self._serving is None:
+            self._serving = self.serving_engine(gen_cfg=gcfg)
+        return self._serving
+
+    def serving_engine(self, **kwargs):
+        """Build a continuous-batching :class:`ServingEngine` over this
+        artifact's module + params (kwargs forward: slots, cache_len,
+        gen_cfg, ...). The engine handed back owns its own slot cache;
+        call it directly for submit/step/drain streaming serving."""
+        from fleetx_tpu.models.gpt.generation import GenerationConfig
+        from fleetx_tpu.serving import ServingEngine
+
+        if "gen_cfg" not in kwargs:
+            kwargs["gen_cfg"] = GenerationConfig.from_config(
+                dict(self.cfg.get("Generation") or {}))
+        return ServingEngine(self.module.nets, {"params": self.params},
+                             **kwargs)

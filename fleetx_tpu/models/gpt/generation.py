@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["GenerationConfig", "generate", "process_logits", "prompt_seen",
-           "mark_seen"]
+           "mark_seen", "init_decode_cache", "decode_step"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -153,9 +153,55 @@ def right_size_decode_cache(model, total_len: int):
     return model, cache_len
 
 
-def _top_p_cutoff_bisect(logits, top_p: float, iters: int = 40):
+def init_decode_cache(model, batch: int):
+    """Zero decode kv-cache for ``batch`` rows at the model's cache length.
+
+    The fresh cache is deterministically zeros (+ zero index), so it is
+    built from ``eval_shape`` only — no param sampling or forward trace.
+    THE cache constructor for every decode driver: ``generate()``,
+    ``beam_search()``, and the continuous-batching serving engine
+    (fleetx_tpu/serving/) all start from this tree, so its layout
+    ([batch, cache_len, heads, head_dim] per layer + a scalar
+    ``cache_index``) is defined in exactly one place."""
+    cache_shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((batch, 1), jnp.int32),
+            jnp.zeros((batch, 1), jnp.int32),
+            decode=True,
+        )
+    )["cache"]
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+
+
+def decode_step(model, params, cache, input_ids, position_ids, kv_mask=None,
+                cache_positions=None):
+    """One cached decode forward: ``(logits, new_cache)``.
+
+    The single reusable step both the ``generate()`` loop body and the
+    serving engine's scheduler tick are built from (multi-token
+    ``input_ids`` is the prefill case). ``cache_positions`` ([b] int32,
+    optional) routes each row's kv write to its own offset — the
+    continuous-batching path where slots sit at different decode depths;
+    None keeps the shared ``cache_index`` scalar (the one-shot loop)."""
+    logits, mut = model.apply(
+        {"params": params, "cache": cache},
+        input_ids,
+        position_ids,
+        kv_mask,
+        decode=True,
+        cache_positions=cache_positions,
+        mutable=["cache"],
+    )
+    return logits, mut["cache"]
+
+
+def _top_p_cutoff_bisect(logits, top_p, iters: int = 40):
     """Probability threshold t such that keeping {prob >= t} matches the
     smallest descending-sorted prefix with cumulative prob >= top_p.
+    ``top_p`` is a python float or a broadcastable [b, 1] array (the
+    serving engine passes per-request values); rows with top_p >= 1 keep
+    the whole distribution (the threshold bisects to 0).
 
     Bisection over the threshold: each step is one O(vocab) masked-sum VPU
     pass, replacing the O(vocab log vocab) full sort (TPU sorts lower to
@@ -268,30 +314,13 @@ def generate(
     tokens = jnp.full((b, total_len), gen_cfg.pad_token_id, jnp.int32)
     tokens = jax.lax.dynamic_update_slice(tokens, input_ids.astype(jnp.int32), (0, 0))
 
-    # init cache at full length: the fresh cache is deterministically zeros
-    # (+ zero index), so build it from shapes only — no param sampling or
-    # forward trace per call
-    cache_shapes = jax.eval_shape(
-        lambda: model.init(
-            jax.random.PRNGKey(0),
-            jnp.zeros((b, 1), jnp.int32),
-            jnp.zeros((b, 1), jnp.int32),
-            decode=True,
-        )
-    )["cache"]
-    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+    cache = init_decode_cache(model, b)
 
     # prefill: feed the whole prompt, cache fills positions [0, prompt_len)
     pos = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0)
-    logits, mut = model.apply(
-        {"params": params, "cache": cache},
-        input_ids.astype(jnp.int32),
-        pos,
-        kv_mask,
-        decode=True,
-        mutable=["cache"],
+    logits, cache = decode_step(
+        model, params, cache, input_ids.astype(jnp.int32), pos, kv_mask
     )
-    cache = mut["cache"]
     vocab = logits.shape[-1]
     # repetition penalty reads a [b, vocab] seen-token scoreboard updated in
     # O(vocab) per step (mark_seen) instead of rebuilding a one-hot over the
@@ -319,15 +348,10 @@ def generate(
     def body(state):
         i, tokens, seen, cache, finished, rng = state
         cur = jax.lax.dynamic_slice(tokens, (0, i - 1), (b, 1))
-        logits, mut = model.apply(
-            {"params": params, "cache": cache},
-            cur,
-            (i - 1 - pad_counts)[:, None].astype(jnp.int32),
-            kv_mask,
-            decode=True,
-            mutable=["cache"],
+        logits, cache = decode_step(
+            model, params, cache, cur,
+            (i - 1 - pad_counts)[:, None].astype(jnp.int32), kv_mask,
         )
-        cache = mut["cache"]
         rng, step_rng = jax.random.split(rng)
         nl = process_logits(logits[:, -1, :], seen if track_seen else None,
                             i, gen_cfg, prompt_len=prompt_len,

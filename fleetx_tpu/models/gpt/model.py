@@ -172,7 +172,8 @@ class SelfAttention(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x, attn_mask=None, *, deterministic=True, decode=False):
+    def __call__(self, x, attn_mask=None, *, deterministic=True, decode=False,
+                 cache_positions=None):
         cfg = self.cfg
         h, nh, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
 
@@ -189,7 +190,9 @@ class SelfAttention(nn.Module):
         causal = True
         if decode:
             kv_pad_mask = attn_mask  # pre-causal-merge mask: left-pad layout
-            k, v, attn_mask, decode_end = self._update_cache(k, v, attn_mask)
+            k, v, attn_mask, decode_end = self._update_cache(
+                k, v, attn_mask, cache_positions
+            )
             causal = False  # the cache mask encodes absolute-position causality
             if decode_end is not None and self._flash_decode_ok(
                 kv_pad_mask, k.shape[1], deterministic
@@ -261,17 +264,26 @@ class SelfAttention(nn.Module):
         out = attn_out_dense(cfg.hidden_size, cfg.dtype)(out)
         return checkpoint_name(out, "attn_out")
 
-    def _update_cache(self, k, v, attn_mask):
+    def _update_cache(self, k, v, attn_mask, cache_positions=None):
         """Incremental decode: append this step's k/v at cache_index and
         build the absolute-position causal mask (query i at absolute position
         start+i may see cache positions <= start+i). Cache layout
         [batch, max_len, heads, head_dim].
 
+        ``cache_positions`` ([b] int32, optional) gives each batch row its
+        OWN write offset instead of the shared scalar ``cache_index`` — the
+        continuous-batching serving path (fleetx_tpu/serving/) runs slots at
+        different decode depths in one batched step, so row b writes at
+        ``cache_positions[b]`` and attends the per-row causal window
+        ``[0, cache_positions[b] + s)``. The scalar ``cache_index`` is still
+        advanced (to the max write end) so one-shot callers interleaving
+        both styles stay consistent.
+
         Returns ``(k, v, attn_mask, decode_end)``: ``decode_end`` is the
         number of live cache positions after this step's write (the
-        single-query flash-decode kernel's upper bound) — None during init
-        and for multi-token (prefill) calls, where the fast path does not
-        apply."""
+        single-query flash-decode kernel's upper bound; per-row [b] under
+        ``cache_positions``) — None during init and for multi-token
+        (prefill) calls, where the fast path does not apply."""
         is_init = not self.has_variable("cache", "cached_key")
         b, s, nh, hd = k.shape
         max_len = (self.cfg.decode_cache_len
@@ -286,16 +298,30 @@ class SelfAttention(nn.Module):
         idx = self.variable("cache", "cache_index", lambda: jnp.array(0, jnp.int32))
         decode_end = None
         if not is_init:
-            start = idx.value
-            ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, start, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, start, 0, 0))
-            idx.value = start + s
-            if s == 1:
-                decode_end = idx.value
-            k, v = ck.value, cv.value
-            q_pos = start + jnp.arange(s)  # absolute positions of the queries
             k_pos = jnp.arange(max_len)
-            causal = (k_pos[None, :] <= q_pos[:, None])[None, None, :, :]
+            if cache_positions is None:
+                start = idx.value
+                ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, start, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, start, 0, 0))
+                idx.value = start + s
+                if s == 1:
+                    decode_end = idx.value
+                q_pos = start + jnp.arange(s)  # absolute query positions
+                causal = (k_pos[None, :] <= q_pos[:, None])[None, None, :, :]
+            else:
+                wpos = cache_positions.astype(jnp.int32)  # [b] write offsets
+                row_update = jax.vmap(
+                    lambda buf, new, p: jax.lax.dynamic_update_slice(
+                        buf, new, (p, 0, 0))
+                )
+                ck.value = row_update(ck.value, k, wpos)
+                cv.value = row_update(cv.value, v, wpos)
+                idx.value = jnp.max(wpos) + s
+                if s == 1:
+                    decode_end = wpos + 1  # [b]: per-row live window end
+                q_pos = wpos[:, None] + jnp.arange(s)[None, :]  # [b, s]
+                causal = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None, :, :]
+            k, v = ck.value, cv.value
             attn_mask = (
                 causal
                 if attn_mask is None
@@ -396,13 +422,15 @@ class DecoderLayer(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x, attn_mask=None, deterministic=True, decode=False):
+    def __call__(self, x, attn_mask=None, deterministic=True, decode=False,
+                 cache_positions=None):
         cfg = self.cfg
         x = _constrain_act(x, cfg)
         residual = x
         y = _layer_norm(cfg, "norm1")(x)
         y = SelfAttention(cfg, name="attn")(
-            y, attn_mask, deterministic=deterministic, decode=decode
+            y, attn_mask, deterministic=deterministic, decode=decode,
+            cache_positions=cache_positions,
         )
         y = _dropout(cfg, "attn_dropout")(y, deterministic=deterministic)
         x = residual + y
@@ -434,8 +462,11 @@ class _ScanLayer(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x, attn_mask, deterministic, decode):
-        x = DecoderLayer(self.cfg, name="layer")(x, attn_mask, deterministic, decode)
+    def __call__(self, x, attn_mask, deterministic, decode,
+                 cache_positions=None):
+        x = DecoderLayer(self.cfg, name="layer")(
+            x, attn_mask, deterministic, decode, cache_positions
+        )
         return x, None
 
 
@@ -478,7 +509,7 @@ class GPTModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, position_ids=None, attn_mask=None, *,
-                 deterministic=True, decode=False):
+                 deterministic=True, decode=False, cache_positions=None):
         cfg = self.cfg
         word_emb = self.param(
             "word_embeddings",
@@ -505,11 +536,13 @@ class GPTModel(nn.Module):
         x = _constrain_act(x, cfg)
         x = _dropout(cfg, "embed_dropout")(x, deterministic=deterministic)
 
-        x = self._decoder_stack(x, attn_mask, deterministic=deterministic, decode=decode)
+        x = self._decoder_stack(x, attn_mask, deterministic=deterministic,
+                                decode=decode, cache_positions=cache_positions)
         x = _layer_norm(cfg, "final_norm")(x)
         return _constrain_act(x, cfg)
 
-    def _decoder_stack(self, x, attn_mask, *, deterministic, decode):
+    def _decoder_stack(self, x, attn_mask, *, deterministic, decode,
+                       cache_positions=None):
         cfg = self.cfg
         policy = _remat_policy(cfg)
         selective = cfg.no_recompute_layers
@@ -542,11 +575,13 @@ class GPTModel(nn.Module):
                 layer_cls,
                 variable_axes={"params": 0, "cache": 0, "intermediates": 0},
                 split_rngs={"params": True, "dropout": True},
-                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast,
+                         nn.broadcast),
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )
-            x, _ = stack(cfg, name="layers")(x, attn_mask, deterministic, decode)
+            x, _ = stack(cfg, name="layers")(x, attn_mask, deterministic,
+                                             decode, cache_positions)
             return x
         # Unrolled path: needed for per-layer recompute opt-out
         # (no_recompute_layers, reference single_model.py:473-475).
@@ -557,7 +592,9 @@ class GPTModel(nn.Module):
                 layer_cls = nn.remat(
                     DecoderLayer, policy=policy, prevent_cse=False, static_argnums=(3, 4)
                 )
-            x = layer_cls(cfg, name=f"layer_{i}")(x, attn_mask, deterministic, decode)
+            x = layer_cls(cfg, name=f"layer_{i}")(
+                x, attn_mask, deterministic, decode, cache_positions
+            )
         return x
 
 
@@ -571,7 +608,8 @@ class GPTForPretraining(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, position_ids=None, attn_mask=None, *,
-                 deterministic=True, decode=False, labels=None):
+                 deterministic=True, decode=False, cache_positions=None,
+                 labels=None):
         backbone = GPTModel(self.cfg, name="gpt")
         x = backbone(
             input_ids,
@@ -579,6 +617,7 @@ class GPTForPretraining(nn.Module):
             attn_mask,
             deterministic=deterministic,
             decode=decode,
+            cache_positions=cache_positions,
         )
         word_emb = backbone.variables["params"]["word_embeddings"]
         emb = word_emb.value if isinstance(word_emb, nn.Partitioned) else word_emb

@@ -1,0 +1,34 @@
+"""Continuous-batching serving subsystem over the flash-decode fast path.
+
+The runtime layer the reference toolkit never had: instead of one padded
+batch per blocking ``generate()`` call, a slot-based scheduler keeps the
+decode batch full — requests are admitted into free kv-cache slots the
+tick they arrive (prefill-on-insert), every tick runs ONE jitted decode
+step over all slots at their own depths, and finished requests free
+their slot immediately for the next queued request.
+
+    engine = ServingEngine(model, variables, slots=8)
+    rid = engine.submit(prompt_ids, max_length=64)
+    results = engine.drain()          # {rid: ServingResult}
+
+Layout: ``cache_manager`` (slot cache + live-window safety argument),
+``scheduler`` (FIFO admission policy seam), ``engine`` (submit/step/drain
+loop + jitted prefill/decode), ``metrics`` (queue/TTFT/throughput
+observability). docs/SERVING.md has the architecture tour.
+"""
+
+from fleetx_tpu.serving.cache_manager import SlotKVCacheManager, scatter_slot
+from fleetx_tpu.serving.engine import ServingEngine, ServingResult, sample_tokens
+from fleetx_tpu.serving.metrics import ServingMetrics
+from fleetx_tpu.serving.scheduler import FIFOScheduler, Request
+
+__all__ = [
+    "ServingEngine",
+    "ServingResult",
+    "SlotKVCacheManager",
+    "FIFOScheduler",
+    "Request",
+    "ServingMetrics",
+    "sample_tokens",
+    "scatter_slot",
+]

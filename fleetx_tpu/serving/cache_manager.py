@@ -1,0 +1,115 @@
+"""Slot-based kv-cache manager for continuous-batching decode.
+
+Owns ONE fixed ``[slots, cache_len]`` decode cache (the flax 'cache'
+collection tree built by ``generation.init_decode_cache``) and maps
+requests onto free slots. The flash-decode live-window contract
+(ops/pallas/decode_attention.py) is what makes slot reuse safe without
+ever zeroing the buffers:
+
+- each slot's attention window is ``[0, lengths[slot] + 1)`` — the
+  per-row ``end`` the serving decode step derives from its write
+  positions — so K/V rows a *previous* tenant left beyond the current
+  length are never attended;
+- a fresh tenant's prefill overwrites ``[0, prompt_len)`` and every
+  decode tick overwrites position ``lengths[slot]`` *before* the window
+  grows to include it, so stale rows are always replaced before they
+  become visible.
+
+The scalar ``cache_index`` leaves inside the tree are unused on this
+path (per-slot progress lives in ``lengths``; the model receives explicit
+``cache_positions`` instead) — see ``SelfAttention._update_cache``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["SlotKVCacheManager", "scatter_slot"]
+
+
+def scatter_slot(cache, prefill_cache, slot):
+    """Write a 1-row prefill cache tree into row ``slot`` of the slot cache.
+
+    Pure function (used inside the engine's jitted prefill, ``slot`` may be
+    traced). K/V leaves carry a ``[..., batch, cache_len, heads, head_dim]``
+    suffix — the batch axis sits at -4 for both the scan-stacked
+    ``[layers, batch, ...]`` and the unrolled nested layouts — and are
+    updated at that axis; rank-<4 leaves (the ``cache_index`` scalars) are
+    left untouched, since per-slot progress is tracked by the manager."""
+
+    def put(big, small):
+        if big.ndim < 4:
+            return big
+        starts = (0,) * (big.ndim - 4) + (slot, 0, 0, 0)
+        return jax.lax.dynamic_update_slice(big, small, starts)
+
+    return jax.tree.map(put, cache, prefill_cache)
+
+
+class SlotKVCacheManager:
+    """Fixed-slot decode cache + slot bookkeeping (free list, tenants).
+
+    ``cache`` is the live device tree; the engine routes it through its
+    jitted prefill/decode functions and stores the result back here.
+    ``lengths`` is the HOST mirror of per-slot live row counts (the device
+    copy rides the engine's state dict) — kept for observability without a
+    device sync."""
+
+    def __init__(self, model, slots: int, cache_len: int):
+        from fleetx_tpu.models.gpt.generation import init_decode_cache
+
+        if slots < 1:
+            raise ValueError(f"need at least one slot, got {slots}")
+        if (model.cfg.decode_cache_len or 0) != cache_len:
+            raise ValueError(
+                f"model.cfg.decode_cache_len ({model.cfg.decode_cache_len}) "
+                f"must equal the manager's cache_len ({cache_len})"
+            )
+        self.slots = slots
+        self.cache_len = cache_len
+        self.cache = init_decode_cache(model, slots)
+        self.lengths = np.zeros(slots, np.int64)
+        self.request_ids: List[Optional[int]] = [None] * slots
+        # lowest-index-first allocation keeps runs deterministic
+        self._free = list(range(slots - 1, -1, -1))
+
+    @property
+    def free_count(self) -> int:
+        """Number of slots available for admission."""
+        return len(self._free)
+
+    @property
+    def active_count(self) -> int:
+        """Number of slots currently holding a live request."""
+        return self.slots - len(self._free)
+
+    def occupancy(self) -> float:
+        """Fraction of slots holding a live request."""
+        return self.active_count / self.slots
+
+    def alloc(self, request_id: int, prompt_len: int) -> Optional[int]:
+        """Claim the lowest free slot for ``request_id`` (None when full)."""
+        if not self._free:
+            return None
+        if prompt_len > self.cache_len:
+            raise ValueError(
+                f"prompt_len {prompt_len} exceeds cache_len {self.cache_len}"
+            )
+        slot = self._free.pop()
+        self.request_ids[slot] = request_id
+        self.lengths[slot] = prompt_len
+        return slot
+
+    def free(self, slot: int) -> None:
+        """Release ``slot`` for the next queued request. No buffer zeroing:
+        the live-window contract (module docstring) keeps stale rows
+        invisible to the next tenant."""
+        if self.request_ids[slot] is None:
+            raise ValueError(f"slot {slot} is already free")
+        self.request_ids[slot] = None
+        self.lengths[slot] = 0
+        self._free.append(slot)
+        self._free.sort(reverse=True)

@@ -1,0 +1,524 @@
+"""ServingEngine: continuous-batching decode over the slot kv-cache.
+
+The runtime layer between "a stream of requests" and the single-step
+decode functions exposed by ``models/gpt/generation.py``:
+
+- **submit()** queues a request (FIFO) with per-request overrides for
+  max/min length, EOS, sampling knobs, and an independent RNG stream.
+- **step()** is one scheduler tick: admit queued requests into free slots
+  (prefill-on-insert — each prompt is prefilled batch-1 into a fresh
+  cache and scattered into its slot, its first token sampled in the same
+  jitted call), then ONE jitted decode step over ALL slots, then per-slot
+  EOS / max-length retirement that frees slots for the next tick's
+  admissions.
+- **drain()** ticks until queue and slots are empty and returns the
+  finished :class:`ServingResult` records.
+
+Per-slot progress is carried as explicit ``cache_positions`` into the
+model (``SelfAttention._update_cache``), so slots decode at different
+depths in one batched forward; each row's attention window is
+``[0, lengths[slot]+1)`` — on TPU the flash-decode kernel receives that
+window as its per-row ``end`` and streams only the live prefix. Inactive
+slots ride the batched step with their writes pinned to the last cache
+row and their outputs discarded; a freed slot's stale K/V is never
+attended (see ``cache_manager.py``).
+
+Unsupported request shapes (beam search, repetition penalty, forced
+EOS/BOS) raise at construction/submit — they need cross-step state the
+slot loop does not carry; use the one-shot ``generate()`` for those.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fleetx_tpu.models.gpt.generation import (
+    GenerationConfig,
+    _top_p_cutoff_bisect,
+    decode_step,
+    init_decode_cache,
+)
+from fleetx_tpu.serving.cache_manager import SlotKVCacheManager, scatter_slot
+from fleetx_tpu.serving.metrics import ServingMetrics
+from fleetx_tpu.serving.scheduler import FIFOScheduler, Request
+from fleetx_tpu.utils.log import logger
+
+__all__ = ["ServingEngine", "ServingResult", "sample_tokens"]
+
+_NEG = -1e9
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def sample_tokens(logits, keys, greedy, temperature, top_k, top_p, *,
+                  topk_cap: int):
+    """Vectorized per-row sampler: each batch row applies ITS OWN decode
+    strategy (greedy flag, temperature, top-k, top-p) and draws from its
+    own rng key — the per-request-overrides core of the serving engine.
+
+    ``top_k`` must be pre-normalized to ``[0, topk_cap]`` (0 = no filter;
+    the engine clamps larger requests at submit): one static
+    ``lax.top_k(topk_cap)`` partial sort serves every row, the per-row
+    cutoff is the row's k-th entry of it. Top-p reuses the sort-free
+    threshold bisection from ``generation.py`` with per-row targets;
+    greedy rows take the argmax of the unfiltered logits (exactly
+    ``_sample``'s greedy branch, so greedy parity with ``generate()``
+    holds per row)."""
+    greedy_tok = jnp.argmax(logits, axis=-1)
+    vocab = logits.shape[-1]
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    cap = max(1, min(topk_cap, vocab))
+    vals = jax.lax.top_k(scaled, cap)[0]  # [b, cap] descending
+    kth = jnp.take_along_axis(
+        vals, jnp.clip(top_k - 1, 0, cap - 1)[:, None], axis=-1
+    )
+    filtered = jnp.where((top_k > 0)[:, None] & (scaled < kth), _NEG, scaled)
+    probs, thresh = _top_p_cutoff_bisect(filtered, top_p[:, None])
+    filtered = jnp.where(probs >= thresh, filtered, _NEG)
+    sampled = jax.vmap(jax.random.categorical)(keys, filtered)
+    return jnp.where(greedy, greedy_tok, sampled).astype(jnp.int32)
+
+
+@dataclasses.dataclass
+class ServingResult:
+    """Final outcome of one request: generated tokens + latency stats."""
+
+    id: int
+    prompt: np.ndarray
+    tokens: np.ndarray  # generated tokens (EOS included when hit)
+    finish_reason: str  # eos | max_length | cache_full
+    ttft_s: float
+    latency_s: float
+
+    @property
+    def sequence(self) -> np.ndarray:
+        """prompt + generated tokens, the one-shot ``generate()`` layout
+        minus the post-EOS pad fill."""
+        return np.concatenate([self.prompt, self.tokens])
+
+
+class ServingEngine:
+    """Slot-based continuous-batching serving loop (module docstring)."""
+
+    def __init__(self, model, variables, *, slots: Optional[int] = None,
+                 cache_len: Optional[int] = None,
+                 gen_cfg: Optional[GenerationConfig] = None,
+                 base_seed: int = 0, topk_cap: Optional[int] = None,
+                 prefill_bucket: Optional[int] = None,
+                 log_every: Optional[int] = None,
+                 metrics: Optional[ServingMetrics] = None):
+        gen_cfg = gen_cfg or GenerationConfig(decode_strategy="greedy")
+        if gen_cfg.repetition_penalty != 1.0:
+            raise ValueError("continuous batching does not support "
+                             "repetition_penalty (use one-shot generate())")
+        if gen_cfg.forced_eos_token_id is not None:
+            raise ValueError("continuous batching does not support "
+                             "forced_eos_token_id")
+        self.gen_cfg = gen_cfg
+        self.slots = slots or _env_int("FLEETX_SERVING_SLOTS", 8)
+        cache_len = (cache_len
+                     or _env_int("FLEETX_SERVING_CACHE_LEN", 0)
+                     or model.cfg.max_position_embeddings)
+        if model.cfg.use_flash_attention:
+            # round up to the flash-decode kernel's 8-row KV tile so the
+            # fast path engages; the extra rows are never attended
+            cache_len += -cache_len % 8
+        self.cache_len = cache_len
+        self.model = model.clone(
+            cfg=dataclasses.replace(model.cfg, decode_cache_len=cache_len))
+        self.params = (variables["params"]
+                       if isinstance(variables, dict) and "params" in variables
+                       else variables)
+        self.topk_cap = topk_cap or _env_int("FLEETX_SERVING_TOPK_CAP", 64)
+        self.prefill_bucket = (prefill_bucket
+                               or _env_int("FLEETX_SERVING_PREFILL_BUCKET", 32))
+        self.log_every = (log_every if log_every is not None
+                          else _env_int("FLEETX_SERVING_LOG_EVERY", 0))
+        self.cache_manager = SlotKVCacheManager(self.model, self.slots,
+                                                cache_len)
+        self.scheduler = FIFOScheduler()
+        self.metrics = metrics or ServingMetrics(self.slots)
+        self._base_key = jax.random.PRNGKey(base_seed)
+        self._next_id = 0
+        self._ticks = 0
+        self._active: Dict[int, Request] = {}  # slot -> request
+        self._results: Dict[int, ServingResult] = {}
+        self._state = self._init_state()
+        # buffer donation halves cache HBM residency on TPU; skipped on
+        # CPU/interpret runs where XLA would only warn about it
+        donate = jax.default_backend() in ("tpu", "axon")
+        # all_greedy is static: an all-greedy tick (the common serving mix
+        # for deterministic decode) skips the sampler entirely — at most
+        # two cached compilations
+        self._decode_jit = jax.jit(
+            self._decode_fn, static_argnums=(3,),
+            donate_argnums=(1, 2) if donate else ())
+        self._admit_jit = jax.jit(self._admit_fn, donate_argnums=())
+        self._prefill_jits = {}  # bucketed prompt length -> jitted prefill
+        self._donate_cache = donate
+
+    # ------------------------------------------------------------ lifecycle
+
+    def submit(self, prompt, *, max_length: Optional[int] = None,
+               min_length: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               decode_strategy: Optional[str] = None,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None, top_p: Optional[float] = None,
+               seed: Optional[int] = None, rng_key: Optional[jax.Array] = None,
+               on_token=None) -> int:
+        """Queue one request; returns its id. Kwargs override the engine's
+        ``gen_cfg`` defaults per request; ``seed`` (or a raw ``rng_key``)
+        pins this request's private sampling stream, ``on_token`` streams
+        ``(request_id, token, finished)`` per decoded token."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        g = self.gen_cfg
+        strategy = decode_strategy or g.decode_strategy
+        if strategy not in ("greedy", "sampling"):
+            raise ValueError(
+                f"decode_strategy {strategy!r} not servable by continuous "
+                "batching (beam search needs one-shot generate())")
+        limit = min(self.cache_len, self.model.cfg.max_position_embeddings)
+        if prompt.size >= limit:
+            raise ValueError(
+                f"prompt_len {prompt.size} leaves no decode room "
+                f"(cache/position limit {limit})")
+        max_new = int(max_length if max_length is not None else g.max_length)
+        if prompt.size + max_new > limit:
+            clamped = limit - prompt.size
+            logger.warning(
+                "serving: request %d max_length %d clamped to %d "
+                "(prompt %d + limit %d)", self._next_id, max_new, clamped,
+                prompt.size, limit)
+            max_new = clamped
+        min_new = min(int(min_length if min_length is not None
+                          else g.min_length), max_new)
+        eos = int(eos_token_id if eos_token_id is not None
+                  else (g.eos_token_id if g.eos_token_id is not None else -1))
+        vocab = self.model.cfg.vocab_size
+        tk = int(top_k if top_k is not None else g.top_k)
+        if tk <= 0 or tk >= vocab:
+            tk = 0  # no filter (matches _sample's vocab clamp)
+        elif tk > self.topk_cap:
+            logger.warning(
+                "serving: request %d top_k %d clamped to topk_cap %d "
+                "(FLEETX_SERVING_TOPK_CAP)", self._next_id, tk, self.topk_cap)
+            tk = self.topk_cap
+        rid = self._next_id
+        self._next_id += 1
+        if rng_key is None:
+            rng_key = (jax.random.PRNGKey(int(seed)) if seed is not None
+                       else jax.random.fold_in(self._base_key, rid))
+        req = Request(
+            id=rid, prompt=prompt, max_new_tokens=max(max_new, 1),
+            min_new_tokens=min_new, eos_token_id=eos,
+            greedy=strategy == "greedy",
+            temperature=float(temperature if temperature is not None
+                              else g.temperature),
+            top_k=tk,
+            top_p=float(top_p if top_p is not None else g.top_p),
+            rng_key=rng_key, on_token=on_token,
+            submit_time=time.perf_counter(),
+        )
+        self.scheduler.submit(req)
+        self.metrics.record_submit()
+        return rid
+
+    def step(self) -> Dict:
+        """One scheduler tick: admissions, one batched decode step,
+        retirements. Returns a small summary dict."""
+        admitted = 0
+        while self.cache_manager.free_count and len(self.scheduler):
+            self._admit(self.scheduler.pop_next())
+            admitted += 1
+        decoded = len(self._active)
+        retired = []
+        if decoded:
+            retired = self._tick_decode()
+        self._ticks += 1
+        self.metrics.observe_tick(self.scheduler.queue_depth,
+                                  len(self._active))
+        if self.log_every and self._ticks % self.log_every == 0:
+            self.metrics.log_snapshot()
+        return {"admitted": admitted, "decoded": decoded, "retired": retired,
+                "queue_depth": self.scheduler.queue_depth,
+                "active_slots": len(self._active)}
+
+    def drain(self, max_ticks: Optional[int] = None) -> Dict[int, ServingResult]:
+        """Tick until queue and slots are empty (or ``max_ticks``), then
+        return-and-clear every finished result since the last drain."""
+        n = 0
+        while len(self.scheduler) or self._active:
+            self.step()
+            n += 1
+            if max_ticks is not None and n >= max_ticks:
+                break
+        out, self._results = self._results, {}
+        return out
+
+    def generate_batch(self, input_ids, gen_cfg: Optional[GenerationConfig]
+                       = None, rng: Optional[jax.Array] = None):
+        """One-shot convenience with ``generate()``'s contract: every row
+        of ``input_ids`` [b, prompt_len] becomes a request, and the result
+        is the [b, prompt_len + max_length] token buffer (pad fill after
+        EOS). Greedy rows are byte-identical to one-shot ``generate()``;
+        sampling rows draw from per-row streams split off ``rng``."""
+        g = gen_cfg or self.gen_cfg
+        ids = np.asarray(input_ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        b, prompt_len = ids.shape
+        limit = min(self.cache_len, self.model.cfg.max_position_embeddings)
+        if prompt_len + g.max_length > limit:
+            # one-shot generate()'s contract: a decode that cannot fit the
+            # position table (or this engine's slot cache) is an error here,
+            # not the streaming submit()'s clamp-and-warn
+            raise ValueError(
+                f"prompt_len({prompt_len}) + max_length({g.max_length}) "
+                f"exceeds the engine's decode limit ({limit}: "
+                f"min(cache_len, max_position_embeddings))")
+        if rng is None:
+            rng = self._base_key
+        rids = [
+            self.submit(
+                ids[i], max_length=g.max_length, min_length=g.min_length,
+                eos_token_id=g.eos_token_id, decode_strategy=g.decode_strategy,
+                temperature=g.temperature, top_k=g.top_k, top_p=g.top_p,
+                rng_key=jax.random.fold_in(rng, i),
+            )
+            for i in range(b)
+        ]
+        results = self.drain()
+        out = np.full((b, prompt_len + g.max_length), g.pad_token_id,
+                      np.int32)
+        out[:, :prompt_len] = ids
+        for i, rid in enumerate(rids):
+            toks = results[rid].tokens
+            out[i, prompt_len:prompt_len + len(toks)] = toks
+        return jnp.asarray(out)
+
+    def result(self, request_id: int) -> Optional[ServingResult]:
+        """Finished result for ``request_id`` (None while in flight)."""
+        return self._results.get(request_id)
+
+    # ------------------------------------------------------------- internals
+
+    def _init_state(self):
+        s = self.slots
+        return {
+            "last_tok": jnp.zeros((s,), jnp.int32),
+            "lengths": jnp.zeros((s,), jnp.int32),
+            "decoded": jnp.zeros((s,), jnp.int32),
+            "active": jnp.zeros((s,), bool),
+            "eos": jnp.full((s,), -1, jnp.int32),
+            "max_new": jnp.ones((s,), jnp.int32),
+            "min_new": jnp.zeros((s,), jnp.int32),
+            "greedy": jnp.ones((s,), bool),
+            "temperature": jnp.ones((s,), jnp.float32),
+            "top_k": jnp.zeros((s,), jnp.int32),
+            "top_p": jnp.ones((s,), jnp.float32),
+            "rng": jnp.zeros((s, 2), jnp.uint32),
+        }
+
+    def _admit_fn(self, st, slot, tok, length, active, eos, max_new, min_new,
+                  greedy, temperature, top_k, top_p, key):
+        """Jitted: install one admitted request's scalars into slot
+        ``slot`` of the device state (first token already sampled)."""
+        return {
+            "last_tok": st["last_tok"].at[slot].set(tok),
+            "lengths": st["lengths"].at[slot].set(length),
+            "decoded": st["decoded"].at[slot].set(1),
+            "active": st["active"].at[slot].set(active),
+            "eos": st["eos"].at[slot].set(eos),
+            "max_new": st["max_new"].at[slot].set(max_new),
+            "min_new": st["min_new"].at[slot].set(min_new),
+            "greedy": st["greedy"].at[slot].set(greedy),
+            "temperature": st["temperature"].at[slot].set(temperature),
+            "top_k": st["top_k"].at[slot].set(top_k),
+            "top_p": st["top_p"].at[slot].set(top_p),
+            "rng": st["rng"].at[slot].set(key),
+        }
+
+    def _make_prefill(self, bucket_len: int):
+        """Jitted prefill-on-insert for prompts bucketed to ``bucket_len``:
+        batch-1 cached forward into a fresh cache, scatter into the slot,
+        sample the first token — one device round-trip per admission."""
+        max_pos = self.model.cfg.max_position_embeddings
+
+        def prefill(params, cache, prompt, true_len, slot, eos, min_new,
+                    greedy, temperature, top_k, top_p, key):
+            ids = prompt[None, :]
+            # right-pad bucket tail: causal masking keeps the tail out of
+            # every position <= true_len-1, and its K/V rows sit beyond the
+            # live window until decode overwrites them one by one
+            pos = jnp.minimum(jnp.arange(bucket_len, dtype=jnp.int32),
+                              max_pos - 1)[None, :]
+            logits, small = decode_step(
+                self.model, params, init_decode_cache(self.model, 1), ids, pos)
+            cache = scatter_slot(cache, small, slot)
+            last = jax.lax.dynamic_slice_in_dim(
+                logits[0], true_len - 1, 1, axis=0).astype(jnp.float32)
+            vocab = last.shape[-1]
+            last = jnp.where(
+                (jnp.arange(vocab)[None, :] == eos) & (min_new > 0),
+                _NEG, last)
+            tok = sample_tokens(
+                last, key[None], greedy[None], temperature[None],
+                top_k[None], top_p[None], topk_cap=self.topk_cap)[0]
+            return cache, tok
+
+        return jax.jit(
+            prefill, donate_argnums=(1,) if self._donate_cache else ())
+
+    def _admit(self, req: Request) -> None:
+        slot = self.cache_manager.alloc(req.id, req.prompt_len)
+        req.slot = slot
+        bucket = -(-req.prompt_len // self.prefill_bucket) * self.prefill_bucket
+        bucket = min(max(bucket, req.prompt_len), self.cache_len)
+        fn = self._prefill_jits.get(bucket)
+        if fn is None:
+            fn = self._prefill_jits[bucket] = self._make_prefill(bucket)
+        padded = np.zeros(bucket, np.int32)
+        padded[:req.prompt_len] = req.prompt
+        step_key, carry_key = jax.random.split(req.rng_key)
+        cache, tok = fn(
+            self.params, self.cache_manager.cache, jnp.asarray(padded),
+            jnp.asarray(req.prompt_len, jnp.int32),
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(req.eos_token_id, jnp.int32),
+            jnp.asarray(req.min_new_tokens, jnp.int32),
+            jnp.asarray(req.greedy),
+            jnp.asarray(req.temperature, jnp.float32),
+            jnp.asarray(req.top_k, jnp.int32),
+            jnp.asarray(req.top_p, jnp.float32),
+            step_key,
+        )
+        self.cache_manager.cache = cache
+        tok = int(tok)  # host sync: the first token is now observable
+        now = time.perf_counter()
+        req.admit_time = req.first_token_time = now
+        req.tokens.append(tok)
+        self.metrics.record_admit(now - req.submit_time)
+        self.metrics.record_first_token(now - req.submit_time)
+        self.metrics.record_tokens(1)
+        done_eos = req.eos_token_id >= 0 and tok == req.eos_token_id
+        done = done_eos or req.max_new_tokens <= 1
+        if req.on_token:
+            req.on_token(req.id, tok, done)
+        self._state = self._admit_jit(
+            self._state, jnp.asarray(slot, jnp.int32),
+            jnp.asarray(tok, jnp.int32),
+            jnp.asarray(req.prompt_len, jnp.int32),
+            jnp.asarray(not done),
+            jnp.asarray(req.eos_token_id, jnp.int32),
+            jnp.asarray(req.max_new_tokens, jnp.int32),
+            jnp.asarray(req.min_new_tokens, jnp.int32),
+            jnp.asarray(req.greedy),
+            jnp.asarray(req.temperature, jnp.float32),
+            jnp.asarray(req.top_k, jnp.int32),
+            jnp.asarray(req.top_p, jnp.float32),
+            carry_key,
+        )
+        if done:
+            self._finalize(req, "eos" if done_eos else "max_length", now)
+        else:
+            self._active[slot] = req
+
+    def _decode_fn(self, params, cache, st, all_greedy: bool):
+        """Jitted: ONE decode token for every slot (inactive slots ride
+        along with writes pinned to the last cache row, outputs ignored).
+        ``all_greedy`` is static — greedy-only ticks take a bare argmax and
+        skip the sampler's top-k sort / top-p bisection / rng split."""
+        active = st["active"]
+        lengths = st["lengths"]
+        max_pos = self.model.cfg.max_position_embeddings
+        wpos = jnp.where(active, lengths, self.cache_len - 1)
+        posid = jnp.where(active, jnp.minimum(lengths, max_pos - 1), 0)
+        logits, cache = decode_step(
+            self.model, params, cache, st["last_tok"][:, None],
+            posid[:, None], None, cache_positions=wpos)
+        step = logits[:, -1, :].astype(jnp.float32)
+        vocab = step.shape[-1]
+        suppress = ((st["decoded"] < st["min_new"])[:, None]
+                    & (jnp.arange(vocab)[None, :] == st["eos"][:, None]))
+        step = jnp.where(suppress, _NEG, step)
+        if all_greedy:
+            tok = jnp.argmax(step, axis=-1).astype(jnp.int32)
+            new_rng = st["rng"]  # greedy consumes no randomness
+        else:
+            keys = jax.vmap(functools.partial(jax.random.split, num=2))(
+                st["rng"])
+            tok = sample_tokens(step, keys[:, 0], st["greedy"],
+                                st["temperature"], st["top_k"], st["top_p"],
+                                topk_cap=self.topk_cap)
+            new_rng = jnp.where(active[:, None], keys[:, 1], st["rng"])
+        new_len = lengths + 1
+        decoded = st["decoded"] + 1
+        done = active & (
+            (tok == st["eos"])
+            | (decoded >= st["max_new"])
+            | (new_len >= self.cache_len)
+        )
+        new_st = dict(st)
+        new_st["last_tok"] = jnp.where(active, tok, st["last_tok"])
+        new_st["lengths"] = jnp.where(active, new_len, lengths)
+        new_st["decoded"] = jnp.where(active, decoded, st["decoded"])
+        new_st["active"] = active & ~done
+        new_st["rng"] = new_rng
+        return cache, new_st, tok, done
+
+    def _tick_decode(self):
+        all_greedy = all(r.greedy for r in self._active.values())
+        cache, st, tok, done = self._decode_jit(
+            self.params, self.cache_manager.cache, self._state, all_greedy)
+        self.cache_manager.cache = cache
+        self._state = st
+        tok_np = np.asarray(tok)  # host sync per tick
+        done_np = np.asarray(done)
+        now = time.perf_counter()
+        retired = []
+        for slot, req in list(self._active.items()):
+            t = int(tok_np[slot])
+            req.tokens.append(t)
+            self.cache_manager.lengths[slot] += 1
+            self.metrics.record_tokens(1)
+            finished = bool(done_np[slot])
+            if req.on_token:
+                req.on_token(req.id, t, finished)
+            if finished:
+                if req.eos_token_id >= 0 and t == req.eos_token_id:
+                    reason = "eos"
+                elif len(req.tokens) >= req.max_new_tokens:
+                    reason = "max_length"
+                else:
+                    reason = "cache_full"
+                self._finalize(req, reason, now)
+                retired.append(req.id)
+        return retired
+
+    def _finalize(self, req: Request, reason: str, now: float) -> None:
+        if req.slot in self._active:
+            del self._active[req.slot]
+        self.cache_manager.free(req.slot)
+        self.metrics.record_retire(now - req.submit_time, reason)
+        self._results[req.id] = ServingResult(
+            id=req.id, prompt=req.prompt,
+            tokens=np.asarray(req.tokens, np.int32), finish_reason=reason,
+            ttft_s=(req.first_token_time or now) - req.submit_time,
+            latency_s=now - req.submit_time,
+        )

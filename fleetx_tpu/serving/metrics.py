@@ -1,0 +1,138 @@
+"""Serving observability: queue/slot/latency/throughput counters.
+
+One :class:`ServingMetrics` instance rides a :class:`ServingEngine`; the
+engine feeds it lifecycle events (submit/admit/first-token/retire) and a
+per-tick gauge sample (queue depth, active slots). ``snapshot()`` returns
+the aggregate dict the benches and tests consume; ``log_snapshot()``
+surfaces the same line through ``utils/log.py`` (gate the cadence with
+``FLEETX_SERVING_LOG_EVERY``).
+
+TTFT here is end-to-end: submit → the request's first token is on the
+host (queue wait + prefill + the device sync), which is what a caller
+actually observes — first requests include compile time, so warm up
+before reading latencies as steady-state.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["ServingMetrics"]
+
+
+def _pct(values: List[float], q: float) -> Optional[float]:
+    if not values:
+        return None
+    return float(np.percentile(np.asarray(values), q))
+
+
+class ServingMetrics:
+    """Counters + gauges for one serving engine (see module docstring)."""
+
+    def __init__(self, slots: int = 0):
+        self.slots = slots
+        self.submitted = 0
+        self.admitted = 0
+        self.retired = 0
+        self.tokens_generated = 0
+        self.ticks = 0
+        self.finish_reasons: Dict[str, int] = {}
+        self.ttft_s: List[float] = []
+        self.queue_wait_s: List[float] = []
+        self.latency_s: List[float] = []
+        self.queue_depth = 0
+        self.active_slots = 0
+        self._queue_depth_sum = 0
+        self._queue_depth_peak = 0
+        self._occupancy_sum = 0
+        self._first_token_t: Optional[float] = None
+        self._last_token_t: Optional[float] = None
+
+    def record_submit(self) -> None:
+        """A request entered the admission queue."""
+        self.submitted += 1
+
+    def record_admit(self, queue_wait_s: float) -> None:
+        """A request won a slot after waiting ``queue_wait_s``."""
+        self.admitted += 1
+        self.queue_wait_s.append(float(queue_wait_s))
+
+    def record_first_token(self, ttft_s: float) -> None:
+        """First token of a request reached the host (end-to-end TTFT)."""
+        self.ttft_s.append(float(ttft_s))
+
+    def record_tokens(self, n: int) -> None:
+        """``n`` decode tokens reached the host this tick."""
+        now = time.perf_counter()
+        if self._first_token_t is None:
+            self._first_token_t = now
+        self._last_token_t = now
+        self.tokens_generated += n
+
+    def record_retire(self, latency_s: float, reason: str) -> None:
+        """A request finished (``reason``: eos | max_length | cache_full)."""
+        self.retired += 1
+        self.latency_s.append(float(latency_s))
+        self.finish_reasons[reason] = self.finish_reasons.get(reason, 0) + 1
+
+    def observe_tick(self, queue_depth: int, active_slots: int) -> None:
+        """Per-tick gauge sample from the engine's scheduler loop."""
+        self.ticks += 1
+        self.queue_depth = queue_depth
+        self.active_slots = active_slots
+        self._queue_depth_sum += queue_depth
+        self._queue_depth_peak = max(self._queue_depth_peak, queue_depth)
+        self._occupancy_sum += active_slots
+
+    def snapshot(self) -> Dict:
+        """Aggregate view: counters, queue/occupancy stats, TTFT
+        percentiles, decode tokens/s."""
+        span = None
+        if self._first_token_t is not None and self._last_token_t is not None:
+            span = self._last_token_t - self._first_token_t
+        return {
+            "submitted": self.submitted,
+            "admitted": self.admitted,
+            "retired": self.retired,
+            "tokens_generated": self.tokens_generated,
+            "ticks": self.ticks,
+            "queue_depth": self.queue_depth,
+            "queue_depth_mean": (self._queue_depth_sum / self.ticks
+                                 if self.ticks else 0.0),
+            "queue_depth_peak": self._queue_depth_peak,
+            "active_slots": self.active_slots,
+            "slots": self.slots,
+            "slot_occupancy_mean": (self._occupancy_sum / self.ticks / self.slots
+                                    if self.ticks and self.slots else 0.0),
+            "ttft_ms_mean": (float(np.mean(self.ttft_s)) * 1e3
+                             if self.ttft_s else None),
+            "ttft_ms_p50": (None if not self.ttft_s
+                            else _pct(self.ttft_s, 50) * 1e3),
+            "ttft_ms_p95": (None if not self.ttft_s
+                            else _pct(self.ttft_s, 95) * 1e3),
+            "queue_wait_ms_mean": (float(np.mean(self.queue_wait_s)) * 1e3
+                                   if self.queue_wait_s else None),
+            "latency_ms_mean": (float(np.mean(self.latency_s)) * 1e3
+                                if self.latency_s else None),
+            "decode_tokens_per_s": (self.tokens_generated / span
+                                    if span and span > 0 else None),
+            "finish_reasons": dict(self.finish_reasons),
+        }
+
+    def log_snapshot(self) -> None:
+        """One structured log line through the framework logger."""
+        from fleetx_tpu.utils.log import logger
+
+        s = self.snapshot()
+        logger.info(
+            "serving: queue=%d active=%d/%d retired=%d/%d tokens=%d "
+            "occupancy=%.2f tok/s=%s ttft_ms_p50=%s",
+            s["queue_depth"], s["active_slots"], s["slots"], s["retired"],
+            s["submitted"], s["tokens_generated"], s["slot_occupancy_mean"],
+            ("%.1f" % s["decode_tokens_per_s"]
+             if s["decode_tokens_per_s"] else "-"),
+            ("%.1f" % s["ttft_ms_p50"] if s["ttft_ms_p50"] else "-"),
+        )

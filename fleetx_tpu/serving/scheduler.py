@@ -1,0 +1,76 @@
+"""Continuous-batching scheduler: admission queue + request records.
+
+The policy seam of the serving stack. ``FIFOScheduler`` is deliberately
+minimal — arrival order in, arrival order out — because admission policy
+is the part operators replace first (priority tiers, per-tenant fairness,
+SLA-aware preemption all slot in here without touching the engine): the
+engine only asks "how deep is the queue" and "who is next".
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Callable, List, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["Request", "FIFOScheduler"]
+
+
+@dataclasses.dataclass
+class Request:
+    """One in-flight serving request and its per-request decode knobs.
+
+    ``rng_key`` is this request's OWN sampling stream (derived from the
+    engine base key and the request id, or an explicit per-request seed),
+    so repeated identical submissions sample independently. ``on_token``
+    streams each decoded token as ``on_token(request_id, token, finished)``
+    the tick it is produced."""
+
+    id: int
+    prompt: np.ndarray  # [prompt_len] int32, no padding
+    max_new_tokens: int
+    min_new_tokens: int
+    eos_token_id: int  # -1 disables EOS retirement
+    greedy: bool
+    temperature: float
+    top_k: int  # 0 = no filter (engine normalizes >=vocab to 0)
+    top_p: float
+    rng_key: jax.Array
+    on_token: Optional[Callable[[int, int, bool], None]] = None
+    submit_time: float = 0.0
+    # filled in by the engine over the request's lifecycle
+    slot: Optional[int] = None
+    admit_time: Optional[float] = None
+    first_token_time: Optional[float] = None
+    tokens: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def prompt_len(self) -> int:
+        """Number of real prompt tokens."""
+        return int(self.prompt.shape[0])
+
+
+class FIFOScheduler:
+    """First-in-first-out admission queue over :class:`Request`."""
+
+    def __init__(self):
+        self._queue: collections.deque = collections.deque()
+
+    def submit(self, request: Request) -> None:
+        """Append a request to the tail of the admission queue."""
+        self._queue.append(request)
+
+    def pop_next(self) -> Optional[Request]:
+        """Next request to admit (None when the queue is empty)."""
+        return self._queue.popleft() if self._queue else None
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting for a slot."""
+        return len(self._queue)

@@ -8,8 +8,11 @@ import numpy as np
 import pytest
 
 
-@pytest.fixture()
-def trained(tmp_path):
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    # module-scoped: one Trainer init + train serves all export tests (each
+    # test exports into its own subdirectory of the shared tmp dir)
+    tmp_path = tmp_path_factory.mktemp("export")
     from fleetx_tpu.core.engine import Trainer
     from fleetx_tpu.models import build_module
     from fleetx_tpu.utils.config import get_config
@@ -109,3 +112,88 @@ def test_inference_engine_generate(trained):
     out = np.asarray(engine.generate(prompt, max_length=4))
     assert out.shape == (1, 7)
     np.testing.assert_array_equal(out[0, :3], [5, 6, 7])
+
+
+@pytest.fixture(scope="module")
+def gen_engine_factory(trained):
+    """Exported generation artifact -> InferenceEngine builder (one export
+    shared by all engine tests; each call builds a fresh engine)."""
+    from fleetx_tpu.core.inference_engine import InferenceEngine
+    from fleetx_tpu.utils.export import export_inference_model
+
+    module, trainer, tmp_path = trained
+    out_dir = str(tmp_path / "exported_gen2")
+    export_inference_model(
+        module, trainer.state.params, out_dir, input_spec=module.input_spec()
+    )
+
+    def build(**kwargs):
+        return InferenceEngine(out_dir, **kwargs)
+
+    return build
+
+
+def test_engine_generate_delegates_to_serving(gen_engine_factory, monkeypatch):
+    """Servable calls must route through the continuous-batching engine
+    and still produce the one-shot [b, prompt+max] buffer byte-exactly."""
+    prompt = np.asarray([[5, 6, 7], [11, 3, 8]], np.int32)
+    engine = gen_engine_factory()
+    out = np.asarray(engine.generate(prompt, max_length=5,
+                                     decode_strategy="greedy"))
+    assert engine._serving is not None  # the delegation actually happened
+
+    monkeypatch.setenv("FLEETX_SERVING_DELEGATE", "0")
+    legacy = gen_engine_factory()
+    want = np.asarray(legacy.generate(prompt, max_length=5,
+                                      decode_strategy="greedy"))
+    assert legacy._serving is None  # env opt-out keeps the one-shot loop
+    np.testing.assert_array_equal(out, want)
+
+
+def test_engine_generate_mesh_sharded(gen_engine_factory, eight_devices):
+    """generate() must honor self.mesh like predict() does (the old code
+    ran unsharded): same greedy tokens, sharded over a dp x mp mesh."""
+    from fleetx_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    plain = np.asarray(gen_engine_factory().generate(
+        np.asarray([[5, 6, 7], [11, 3, 8]], np.int32), max_length=5,
+        decode_strategy="greedy"))
+
+    mesh = build_mesh(MeshConfig(dp=2, mp=2), eight_devices[:4])
+    engine = gen_engine_factory(mesh=mesh)
+    out = np.asarray(engine.generate(
+        np.asarray([[5, 6, 7], [11, 3, 8]], np.int32), max_length=5,
+        decode_strategy="greedy"))
+    np.testing.assert_array_equal(out, plain)
+
+
+def test_engine_small_serving_cache_falls_back_one_shot(gen_engine_factory,
+                                                        monkeypatch):
+    """A FLEETX_SERVING_CACHE_LEN too small for the request must fall back
+    to the one-shot loop (full-length output), never silently truncate."""
+    monkeypatch.setenv("FLEETX_SERVING_CACHE_LEN", "8")
+    engine = gen_engine_factory()
+    prompt = np.asarray([[5, 6, 7]], np.int32)
+    out = np.asarray(engine.generate(prompt, max_length=10,
+                                     decode_strategy="greedy"))
+    assert engine._serving is None  # did not delegate
+    assert out.shape == (1, 13)
+    monkeypatch.delenv("FLEETX_SERVING_CACHE_LEN")
+    want = np.asarray(engine.generate(prompt, max_length=10,
+                                      decode_strategy="greedy"))
+    np.testing.assert_array_equal(out, want)
+
+
+def test_engine_sampling_rng_advances_per_call(gen_engine_factory):
+    """Repeated sampling calls must NOT replay the same tokens (the old
+    per-call PRNGKey(seed or 0) reuse); an explicit seed pins the stream."""
+    engine = gen_engine_factory()
+    prompt = np.asarray([[5, 6, 7]], np.int32)
+    kw = dict(max_length=16, min_length=16, decode_strategy="sampling",
+              top_k=0, temperature=1.5)
+    a = np.asarray(engine.generate(prompt, **kw))
+    b = np.asarray(engine.generate(prompt, **kw))
+    assert not np.array_equal(a, b), "call counter not folded into the key"
+    c = np.asarray(engine.generate(prompt, seed=123, **kw))
+    d = np.asarray(engine.generate(prompt, seed=123, **kw))
+    np.testing.assert_array_equal(c, d)

@@ -1,8 +1,10 @@
 """CLI helper tools: the parallel shell executor (reference
-ppfleetx/tools/multiprocess_tool.py) and the Imagen text-embedding
+ppfleetx/tools/multiprocess_tool.py), the Imagen text-embedding
 precompute tool (replacing the reference's in-process T5/DeBERTa encode,
-imagen/utils.py)."""
+imagen/utils.py), and the serving-mode bench harness
+(tools/bench_serving.py, smoke-tested tiny on CPU)."""
 
+import importlib
 import json
 import subprocess
 import sys
@@ -67,6 +69,38 @@ def test_precompute_text_embeddings_hash(tmp_path):
     assert not np.array_equal(embeds[0], embeds[2])
     # rows are masked beyond caption length
     assert np.all(embeds[0][3:] == 0)
+
+
+def test_bench_serving_records_schema(monkeypatch):
+    """Static-vs-continuous serving bench on the tiny CPU config: both
+    modes produce finite throughput records with the documented schema,
+    and the continuous run's tokens are byte-identical to static's
+    (detail.parity — the bench doubles as a scheduling-only comparison)."""
+    monkeypatch.setenv("BENCH_SERVING_TINY", "1")
+    sys.path.insert(0, REPO)
+    import tools.bench_serving as bs
+
+    bs = importlib.reload(bs)  # re-read the _TINY env gate
+    recs = bs.serving_records(n_requests=6, slots=2)
+    assert [r["metric"] for r in recs] == [
+        "gpt_345m_serving_static", "gpt_345m_serving_continuous",
+    ]
+    static, cont = recs
+    for r in recs:
+        assert r["unit"] == "tokens/s"
+        assert np.isfinite(r["value"]) and r["value"] > 0
+        d = r["detail"]
+        assert d["requests"] == 6 and d["slots"] == 2
+        # the acceptance quartet: queue depth, occupancy, TTFT, tokens/s
+        assert np.isfinite(d["queue_depth_mean"])
+        assert 0 < d["slot_occupancy_mean"] <= 1
+        assert d["ttft_ms_p50"] > 0 and d["ttft_ms_p95"] >= d["ttft_ms_p50"]
+        assert d["useful_tokens"] > 0
+    # same useful work, byte-identical tokens, no dead padding in continuous
+    assert cont["detail"]["parity"] is True
+    assert cont["detail"]["useful_tokens"] == static["detail"]["useful_tokens"]
+    assert cont["detail"]["dead_token_frac"] == 0.0
+    assert static["detail"]["generated_tokens"] >= static["detail"]["useful_tokens"]
 
 
 def test_precomputed_embeddings_feed_text_image_dataset(tmp_path):

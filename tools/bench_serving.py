@@ -1,0 +1,239 @@
+"""Serving-mode bench: static vs continuous batching, mixed-length load.
+
+The quantitative case for `fleetx_tpu/serving/`: one fixed workload of
+mixed prompt lengths AND mixed requested decode lengths, run two ways —
+
+- **static**: requests grouped into padded batches of `slots` in arrival
+  order, each batch one blocking `generate()` call running to the batch
+  max; early-finishing rows burn decode steps as dead padding and tokens
+  only surface when the whole batch returns (classic InferenceEngine
+  serving).
+- **continuous**: the same requests through `ServingEngine` — admitted
+  into free slots the tick one opens, retired individually, every decode
+  step full of live rows.
+
+Both modes decode greedily with EOS disabled, so they emit byte-identical
+tokens per request (asserted, `detail.parity`) and the comparison is pure
+scheduling: useful-tokens/s, TTFT, queue depth, slot occupancy.
+
+Standalone:  python tools/bench_serving.py
+In-process:  from tools.bench_serving import serving_records
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+# BENCH_SERVING_TINY=1 shrinks everything for CPU smoke tests of the
+# harness itself (schema + scheduler liveness, not perf)
+_TINY = os.environ.get("BENCH_SERVING_TINY") == "1"
+VOCAB = 128 if _TINY else 50304
+N_REQUESTS = 8 if _TINY else 32
+SLOTS = 3 if _TINY else 8
+PROMPT_RANGE = (3, 9) if _TINY else (32, 192)
+GEN_RANGE = (3, 9) if _TINY else (16, 160)
+
+
+def _model():
+    import jax.numpy as jnp
+
+    from fleetx_tpu.models.gpt.model import GPTConfig, GPTForPretraining
+
+    max_pos = PROMPT_RANGE[1] + GEN_RANGE[1]
+    max_pos += -max_pos % 8
+    cfg = GPTConfig(
+        vocab_size=VOCAB,
+        hidden_size=64 if _TINY else 1024,
+        num_layers=2 if _TINY else 24,
+        num_attention_heads=4 if _TINY else 16,
+        ffn_hidden_size=128 if _TINY else 4096,
+        max_position_embeddings=max_pos,
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+        fuse_attn_qkv=True,
+        use_flash_attention=True,  # flash-decode on TPU; dense on CPU
+        dtype=jnp.float32 if _TINY else jnp.bfloat16,
+    )
+    return GPTForPretraining(cfg)
+
+
+def _workload(n: int):
+    """Deterministic mixed-length request list: (prompt, max_new)."""
+    rng = np.random.RandomState(0)
+    out = []
+    for _ in range(n):
+        plen = rng.randint(PROMPT_RANGE[0], PROMPT_RANGE[1] + 1)
+        gen = rng.randint(GEN_RANGE[0], GEN_RANGE[1] + 1)
+        out.append((rng.randint(0, VOCAB, plen).astype(np.int32), int(gen)))
+    return out
+
+
+def _ttft_stats(ttfts_s):
+    arr = np.asarray(ttfts_s, np.float64) * 1e3
+    return {
+        "ttft_ms_mean": round(float(arr.mean()), 2),
+        "ttft_ms_p50": round(float(np.percentile(arr, 50)), 2),
+        "ttft_ms_p95": round(float(np.percentile(arr, 95)), 2),
+    }
+
+
+def _run_static(model, variables, workload, slots, jit_cache):
+    """Padded batches of ``slots`` in arrival order, each one blocking
+    generate() call; returns (per-request tokens, detail). ``jit_cache``
+    persists the per-batch-shape compiled calls across warmup/timed
+    passes (one-shot serving pays one compile per (batch, prompt, gen)
+    shape — that cost is the warmup's, not the steady state's)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from fleetx_tpu.models.gpt.generation import GenerationConfig, generate
+
+    results = [None] * len(workload)
+    ttfts = [0.0] * len(workload)
+    generated_total = 0
+    depth_samples = []
+    t0 = time.perf_counter()
+    for start in range(0, len(workload), slots):
+        batch = workload[start:start + slots]
+        pmax = max(len(p) for p, _ in batch)
+        gmax = max(g for _, g in batch)
+        ids = np.zeros((len(batch), pmax), np.int32)
+        mask = np.zeros((len(batch), pmax), np.int32)
+        for i, (p, _) in enumerate(batch):
+            ids[i, pmax - len(p):] = p  # left-pad to the batch max
+            mask[i, pmax - len(p):] = 1
+        key = (len(batch), pmax, gmax)
+        if key not in jit_cache:
+            cfg = GenerationConfig(max_length=gmax, min_length=gmax,
+                                   decode_strategy="greedy", eos_token_id=-1,
+                                   pad_token_id=0)
+            jit_cache[key] = jax.jit(functools.partial(
+                generate, model, gen_cfg=cfg))
+        out = np.asarray(jax.device_get(jit_cache[key](
+            variables, input_ids=jnp.asarray(ids),
+            attention_mask=jnp.asarray(mask))))
+        done_t = time.perf_counter()
+        generated_total += len(batch) * gmax
+        # tokens surface only when the whole batch returns
+        for i, (p, g) in enumerate(batch):
+            results[start + i] = out[i, pmax:pmax + g]
+            ttfts[start + i] = done_t - t0
+        depth_samples.append(len(workload) - (start + len(batch)))
+    elapsed = time.perf_counter() - t0
+    useful = sum(g for _, g in workload)
+    detail = {
+        "requests": len(workload),
+        "slots": slots,
+        "useful_tokens": useful,
+        "generated_tokens": generated_total,
+        "dead_token_frac": round(1.0 - useful / generated_total, 3),
+        "elapsed_s": round(elapsed, 3),
+        "queue_depth_mean": round(float(np.mean(depth_samples)), 2),
+        "queue_depth_peak": int(max(depth_samples) + slots),
+        "slot_occupancy_mean": round(useful / generated_total, 3),
+        **_ttft_stats(ttfts),
+    }
+    return results, elapsed, detail
+
+
+def _run_continuous(engine, workload):
+    """All requests submitted up front; drain; engine metrics carry the
+    queue/occupancy/TTFT story."""
+    from fleetx_tpu.serving.metrics import ServingMetrics
+
+    engine.metrics = ServingMetrics(engine.slots)  # fresh gauges per run
+    t0 = time.perf_counter()
+    rids = [engine.submit(p, max_length=g) for p, g in workload]
+    res = engine.drain()
+    elapsed = time.perf_counter() - t0
+    snap = engine.metrics.snapshot()
+    results = [np.asarray(res[r].tokens) for r in rids]
+    useful = sum(g for _, g in workload)
+    detail = {
+        "requests": len(workload),
+        "slots": engine.slots,
+        "useful_tokens": useful,
+        "generated_tokens": snap["tokens_generated"],
+        "dead_token_frac": 0.0,  # every decoded row belongs to a live request
+        "elapsed_s": round(elapsed, 3),
+        "ticks": snap["ticks"],
+        "queue_depth_mean": round(snap["queue_depth_mean"], 2),
+        "queue_depth_peak": snap["queue_depth_peak"],
+        "slot_occupancy_mean": round(snap["slot_occupancy_mean"], 3),
+        "ttft_ms_mean": round(snap["ttft_ms_mean"], 2),
+        "ttft_ms_p50": round(snap["ttft_ms_p50"], 2),
+        "ttft_ms_p95": round(snap["ttft_ms_p95"], 2),
+    }
+    return results, elapsed, detail
+
+
+def serving_records(n_requests: int = N_REQUESTS, slots: int = SLOTS):
+    """One JSON-able record per serving mode (static, continuous), plus a
+    byte-parity assertion between them. Each mode gets an untimed warmup
+    pass so compile time doesn't masquerade as scheduling cost."""
+    import jax
+
+    from fleetx_tpu.models.gpt.generation import GenerationConfig
+    from fleetx_tpu.serving import ServingEngine
+
+    model = _model()
+    workload = _workload(n_requests)
+    variables = jax.jit(model.init)(
+        jax.random.PRNGKey(0),
+        np.zeros((1, PROMPT_RANGE[1]), np.int32),
+    )
+    gen_cfg = GenerationConfig(decode_strategy="greedy", eos_token_id=-1,
+                               pad_token_id=0,
+                               max_length=GEN_RANGE[1])
+    engine = ServingEngine(model, variables, slots=slots,
+                           cache_len=model.cfg.max_position_embeddings,
+                           gen_cfg=gen_cfg,
+                           prefill_bucket=8 if _TINY else 32)
+
+    static_jits = {}
+    _run_static(model, variables, workload, slots, static_jits)  # warmup
+    static_toks, _, static_detail = _run_static(model, variables, workload,
+                                                slots, static_jits)
+    _run_continuous(engine, workload)  # compile warmup
+    cont_toks, _, cont_detail = _run_continuous(engine, workload)
+
+    parity = all(
+        np.array_equal(a, b) for a, b in zip(static_toks, cont_toks)
+    )
+    cont_detail["parity"] = parity
+    device = getattr(jax.devices()[0], "device_kind", "?")
+    records = []
+    for mode, detail in (("static", static_detail),
+                         ("continuous", cont_detail)):
+        detail["device"] = device
+        records.append({
+            "metric": f"gpt_345m_serving_{mode}",
+            "value": round(detail["useful_tokens"] / detail["elapsed_s"], 1),
+            "unit": "tokens/s",
+            "vs_baseline": None,  # reference serves static batches only
+            "detail": detail,
+        })
+    return records
+
+
+if __name__ == "__main__":
+    from fleetx_tpu.utils.device_guard import acquire_devices_or_die
+
+    # BENCH_PLATFORM=cpu for smoke runs (see bench_decode.py on why the
+    # override must happen inside the guard)
+    acquire_devices_or_die(
+        int(os.environ.get("BENCH_INIT_TIMEOUT", 300)), label="bench_serving",
+        platform_override=os.environ.get("BENCH_PLATFORM") or None,
+    )
+    for rec in serving_records():
+        print(json.dumps(rec))
