@@ -32,9 +32,25 @@ from fleetx_tpu.optims.optimizer import build_optimizer
 from fleetx_tpu.parallel import env as dist_env
 from fleetx_tpu.parallel.mesh import DATA_AXES, MeshConfig, build_mesh, use_mesh
 from fleetx_tpu.parallel.sharding import make_rules, param_shardings
+from fleetx_tpu.resilience.faults import faults
 from fleetx_tpu.utils.log import logger
 
-__all__ = ["Trainer", "TrainState"]
+__all__ = ["CheckpointUnrestorable", "SentryAbort", "Trainer", "TrainState"]
+
+
+class CheckpointUnrestorable(RuntimeError):
+    """Checkpoints existed but every candidate failed verified restore
+    (all quarantined). Distinct from the no-checkpoint-yet case — which
+    ``load()`` reports as ``False`` so a first launch can start fresh —
+    because resuming a real run from scratch must fail loudly."""
+
+
+class SentryAbort(RuntimeError):
+    """FLEETX_SENTRY_MAX_SKIPS consecutive train steps were skipped by the
+    step sentry — the data stream (or the optimization itself) is
+    producing nothing but anomalies, so the run stops cleanly instead of
+    spinning. Params/opt_state are still the last healthy step's (skipped
+    steps never touch them) and a checkpoint is written before raising."""
 
 
 class TrainState(struct.PyTreeNode):
@@ -197,6 +213,18 @@ class Trainer:
         self.consumed_samples = 0
         self._ckpt_mgr = None
 
+        # step sentry (docs/RESILIENCE.md): finite/spike check folded into
+        # the jitted train step; anomalous steps are skipped, not applied.
+        # All thresholds are static at trace time (env read here, once).
+        self._sentry_enabled = os.environ.get("FLEETX_SENTRY", "1") == "1"
+        self._sentry_loss_max = float(os.environ.get("FLEETX_SENTRY_LOSS_MAX", 0) or 0)
+        self._sentry_gnorm_max = float(os.environ.get("FLEETX_SENTRY_GNORM_MAX", 0) or 0)
+        self._sentry_max_skips = int(os.environ.get("FLEETX_SENTRY_MAX_SKIPS", 25) or 25)
+        self.sentry_skips = 0  # total skipped steps this run
+        self._sentry_consecutive = 0
+        self.save_failures = 0  # periodic saves that failed (run survived)
+        self._last_saved_meta = None  # (step, epoch, consumed_samples)
+
     # ------------------------------------------------------------------ init
     def init_state(self, sample_batch: Dict[str, np.ndarray]) -> TrainState:
         """Create sharded params + optimizer state directly on the mesh
@@ -237,9 +265,14 @@ class Trainer:
         if resumable:
             # restore the run's own checkpoint right here (don't just skip
             # the pretrained load: callers only invoke load() when ckpt_dir
-            # is set, and a preempted run must not resume from random init)
-            self.load()
+            # is set, and a preempted run must not resume from random init).
+            # If every checkpoint fails verified restore, load() raises
+            # CheckpointUnrestorable (resuming from scratch must be loud);
+            # the False branch only covers a checkpoint dir that emptied
+            # between the latest_step() probe and the restore.
             loaded = None
+            if not self.load():
+                loaded = self.module.load_pretrained(_unbox(self.state.params))
         else:
             loaded = self.module.load_pretrained(_unbox(self.state.params))
         if loaded is not None:
@@ -344,6 +377,9 @@ class Trainer:
             grads_fn = make_grad_fn(self.module, self.accumulate_steps)
 
         module = self.module
+        sentry = self._sentry_enabled
+        loss_max = self._sentry_loss_max
+        gnorm_max = self._sentry_gnorm_max
 
         def train_step(state: TrainState, batch, rng):
             params = state.params
@@ -364,7 +400,24 @@ class Trainer:
                 step=state.step + 1, params=new_params, opt_state=new_opt,
                 extra=new_extra,
             )
-            return new_state, {"loss": loss, "grad_norm": gnorm, **aux}
+            metrics = {"loss": loss, "grad_norm": gnorm, **aux}
+            if sentry:
+                # step sentry: a non-finite or spike-over-threshold step is
+                # SKIPPED — every state leaf (params, opt_state incl. the
+                # optax count, extra) rolls back to the incoming state, so
+                # a NaN batch can never poison a later checkpoint. The
+                # jnp.where select is the identity when ok, so an anomaly-
+                # free run is byte-identical with the sentry on or off.
+                ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+                if loss_max > 0:
+                    ok &= loss <= loss_max
+                if gnorm_max > 0:
+                    ok &= gnorm <= gnorm_max
+                new_state = jax.tree.map(
+                    lambda n, o: jnp.where(ok, n, o), new_state, state
+                )
+                metrics["sentry_ok"] = ok
+            return new_state, metrics
 
         sh = self._state_sharding_tree
         batch_spec = (
@@ -429,7 +482,12 @@ class Trainer:
         # with_logical_constraint silently no-ops and we'd trace (and
         # fully recompile) a differently-sharded program
         with use_mesh(self.mesh), nn.logical_axis_rules(list(self.rules)):
-            return fn.lower(*args, **kwargs).compile().cost_analysis()
+            cost = fn.lower(*args, **kwargs).compile().cost_analysis()
+        # jax-version skew: Compiled.cost_analysis() is one dict on newer
+        # jax but a [dict]-per-computation list on older releases
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        return cost
 
     def _in_context(self, fn, name=None):
         """Run calls (and hence first-call tracing) inside the mesh + logical
@@ -527,7 +585,24 @@ class Trainer:
                 dataset.set_epoch(epoch)  # per-epoch re-masking (ERNIE)
             t_last = time.time()
             loss_window = []
-            for batch in train_data:
+            batches = iter(faults.wrap_train_data(train_data))
+            while True:
+                try:
+                    batch = next(batches)
+                except StopIteration:
+                    break
+                except Exception:
+                    # a dead shard / raising loader mid-epoch: bank the
+                    # healthy progress before surfacing the failure, so a
+                    # restart resumes here instead of the last periodic save
+                    logger.exception(
+                        "train data stream raised at step %d; writing an "
+                        "emergency checkpoint before re-raising", step,
+                    )
+                    self._profiler_maybe_stop(summary=False)
+                    self._guarded_save(epoch)
+                    self.wait_for_checkpoints()
+                    raise
                 if step >= self.max_steps:
                     break
                 if self._preempted:
@@ -554,6 +629,34 @@ class Trainer:
                 device_batch = self._shard_batch(batch)
                 rng = dist_env.data_rank_key(step)
                 self.state, metrics = train_step(self.state, device_batch, rng)
+                if self._sentry_enabled and not bool(metrics["sentry_ok"]):
+                    # skipped step: the batch was consumed from the stream
+                    # (consumed_samples advances -> resume won't re-feed it)
+                    # but no update was applied, so neither the step counter
+                    # nor the per-step rng/lr sequence moves — the applied-
+                    # update trajectory stays identical to a run that never
+                    # saw this batch.
+                    self.consumed_samples += self.cfg.Global.global_batch_size
+                    self.sentry_skips += 1
+                    self._sentry_consecutive += 1
+                    logger.warning(
+                        "sentry: skipped anomalous step %d (loss=%s "
+                        "grad_norm=%s; %d skipped total, %d consecutive)",
+                        step, float(metrics["loss"]),
+                        float(metrics["grad_norm"]), self.sentry_skips,
+                        self._sentry_consecutive,
+                    )
+                    if self._sentry_consecutive >= self._sentry_max_skips:
+                        self._profiler_maybe_stop(summary=False)
+                        self._guarded_save(epoch)
+                        self.wait_for_checkpoints()
+                        raise SentryAbort(
+                            f"{self._sentry_consecutive} consecutive train "
+                            f"steps skipped by the sentry at step {step} "
+                            "(FLEETX_SENTRY_MAX_SKIPS); last healthy state "
+                            "checkpointed")
+                    continue
+                self._sentry_consecutive = 0
                 step += 1
                 # tick before the logging/eval/save hooks so the profiled
                 # step-time window measures the train step, not a periodic
@@ -582,7 +685,7 @@ class Trainer:
                 if self.eval_freq and valid_data is not None and step % self.eval_freq == 0:
                     self.evaluate(valid_data, epoch=epoch)
                 if self.save_steps and step % self.save_steps == 0:
-                    self.save(epoch=epoch)
+                    self._guarded_save(epoch)
             if step >= self.max_steps:
                 break
         self._profiler_maybe_stop()
@@ -696,6 +799,20 @@ class Trainer:
         if self._ckpt_mgr is not None:
             self._ckpt_mgr.wait_until_finished()
 
+    def _guarded_save(self, epoch: int = 0):
+        """Periodic/emergency save that survives a failed write: a full
+        disk or flaky object store must not kill a healthy training run —
+        the failure is logged and counted, and the next cadence retries."""
+        try:
+            self.save(epoch=epoch)
+        except Exception:
+            self.save_failures += 1
+            logger.exception(
+                "checkpoint save failed at step %d (%d failures so far); "
+                "training continues, next save in %d steps",
+                int(self.state.step), self.save_failures, self.save_steps,
+            )
+
     def save(self, epoch: int = 0):
         """Sharded save of {params, opt_state, step} + meta (epoch,
         consumed_samples) — reference meta_state.pdopt semantics
@@ -704,6 +821,24 @@ class Trainer:
 
         mgr = self._ckpt_manager()
         step = int(self.state.step)
+        meta_sig = (step, epoch, self.consumed_samples)
+        if step in (mgr.all_steps() or []):
+            if meta_sig == self._last_saved_meta:
+                # e.g. a preemption save landing right on a periodic-save
+                # step: orbax refuses duplicate steps, and that exact state
+                # (params AND meta) is already safe
+                logger.info("checkpoint for step %d already exists; "
+                            "skipping duplicate save", step)
+                return
+            # same step but the meta moved on — sentry skips advance
+            # consumed_samples with the step counter frozen, and stale meta
+            # would re-feed the skipped batches on resume. Rewrite it.
+            logger.info("checkpoint for step %d exists but meta advanced "
+                        "(consumed_samples %s); rewriting", step,
+                        self.consumed_samples)
+            mgr.wait_until_finished()
+            mgr.delete(step)
+        faults.on_checkpoint_save(step)  # chaos injection point (inert: no-op)
         mgr.save(
             step,
             args=ocp.args.Composite(
@@ -719,6 +854,7 @@ class Trainer:
                 ),
             ),
         )
+        self._last_saved_meta = meta_sig
         logger.info("saved checkpoint at step %d -> %s", step, self.output_dir)
 
     def _dropout_impl(self) -> dict:
@@ -733,36 +869,86 @@ class Trainer:
 
     def load(self, step: Optional[int] = None):
         """Restore; resumes step count, epoch, and data order
-        (consumed_samples -> sampler, eager_engine.py:286-288)."""
-        import orbax.checkpoint as ocp
+        (consumed_samples -> sampler, eager_engine.py:286-288).
 
+        On auto-restore (``step=None``) a corrupt/truncated checkpoint —
+        e.g. a kill that landed between an async save and its finalize —
+        does not end the run: the bad step directory is quarantined to
+        ``<output_dir>/quarantine/`` and the next-older step is tried,
+        walking back until one restores (docs/RESILIENCE.md). An explicit
+        ``step`` still raises on failure: the caller asked for exactly
+        that state, silently substituting another would be worse."""
         mgr = self._ckpt_manager()
-        step = step if step is not None else mgr.latest_step()
-        if step is None:
+        mgr.wait_until_finished()  # never race our own in-flight async save
+        candidates = [step] if step is not None else sorted(
+            mgr.all_steps(), reverse=True)
+        if not candidates:
             logger.warning("no checkpoint found under %s", self.output_dir)
             return False
-        if (
-            step == self._restored_step
-            and self.state is not None
-            and int(self.state.step) == step
-        ):
-            # init_state already restored this step (its resumable branch);
-            # don't pay the multi-GB orbax restore twice on CLI resume paths
+        newest = candidates[0]
+        for cand in candidates:
+            if (
+                cand == self._restored_step
+                and self.state is not None
+                and int(self.state.step) == cand
+            ):
+                # init_state already restored this step (its resumable
+                # branch); don't pay the multi-GB orbax restore twice on
+                # CLI resume paths
+                return True
+            if self.state is None:
+                raise RuntimeError(
+                    "call init_state (or fit) before load, to build shardings")
+            try:
+                restored = self._restore_step(cand)
+            except Exception as e:
+                if step is not None:
+                    raise
+                logger.error(
+                    "checkpoint step %d failed verified restore (%s: %s); "
+                    "quarantining it and falling back to the next-older step",
+                    cand, type(e).__name__, e,
+                )
+                self._quarantine_step(cand)
+                continue
+            self._apply_restored(cand, restored)
+            if cand != newest:
+                logger.warning(
+                    "restored FALLBACK checkpoint step %d — newer step(s) %s "
+                    "were corrupt and quarantined; %d step(s) of progress "
+                    "lost", cand,
+                    [s for s in candidates if s > cand], newest - cand,
+                )
             return True
-        if self.state is None:
-            raise RuntimeError("call init_state (or fit) before load, to build shardings")
+        raise CheckpointUnrestorable(
+            f"no restorable checkpoint under {self.output_dir}: every "
+            f"candidate step {sorted(candidates, reverse=True)} failed "
+            "verified restore and was quarantined")
+
+    def _restore_step(self, step: int):
+        """Restore + verify one checkpoint step (raises on any mismatch)."""
+        import orbax.checkpoint as ocp
+
         abstract = jax.tree.map(
             lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
             _unbox(self.state),
             self._state_sharding_tree,
         )
-        restored = mgr.restore(
+        restored = self._ckpt_manager().restore(
             step,
             args=ocp.args.Composite(
                 state=ocp.args.StandardRestore(abstract),
                 meta=ocp.args.JsonRestore(),
             ),
         )
+        got = int(restored["state"].step)
+        if got != step:
+            raise ValueError(
+                f"checkpoint dir {step} restored step counter {got}")
+        return restored
+
+    def _apply_restored(self, step: int, restored) -> None:
+        """Install a verified restore into trainer state + resume meta."""
         flat = restored["state"]
         self.state = TrainState(
             step=flat.step,
@@ -773,6 +959,10 @@ class Trainer:
         meta = restored["meta"]
         self.start_epoch = meta.get("epoch", 0)
         self.consumed_samples = meta.get("consumed_samples", 0)
+        # seed the duplicate-save signature: a save() at this same step with
+        # unchanged meta must SKIP, not take the delete-then-rewrite path
+        # (which would momentarily leave no restorable copy of this step)
+        self._last_saved_meta = (step, self.start_epoch, self.consumed_samples)
         saved_impl = meta.get("dropout_impl")
         if saved_impl is not None and saved_impl != self._dropout_impl():
             logger.warning(
@@ -783,7 +973,40 @@ class Trainer:
             )
         self._restored_step = step
         logger.info("restored checkpoint step %d (epoch %d)", step, self.start_epoch)
-        return True
+
+    def _quarantine_step(self, step: int) -> None:
+        """Move a corrupt step directory out of the checkpoint root (to
+        ``<output_dir>/quarantine/<step>``) so the manager never offers it
+        again, and refresh the manager's cached step list."""
+        import shutil
+
+        root = os.path.abspath(os.path.join(self.output_dir, "checkpoints"))
+        names = [n for n in os.listdir(root)
+                 if n.isdigit() and int(n) == step]
+        if not names:
+            logger.warning("quarantine: no directory for step %d under %s",
+                           step, root)
+            return
+        qdir = os.path.join(self.output_dir, "quarantine")
+        os.makedirs(qdir, exist_ok=True)
+        for name in names:
+            dst = os.path.join(qdir, name)
+            n = 0
+            while os.path.exists(dst):
+                n += 1
+                dst = os.path.join(qdir, f"{name}.{n}")
+            shutil.move(os.path.join(root, name), dst)
+            logger.warning("quarantined corrupt checkpoint %s -> %s",
+                           os.path.join(root, name), dst)
+        mgr = self._ckpt_manager()
+        try:
+            mgr.reload()
+        except Exception:  # older orbax: rebuild the manager lazily
+            try:
+                mgr.close()
+            except Exception:
+                pass
+            self._ckpt_mgr = None
 
     # ------------------------------------------------------------ preemption
     def _install_preemption_handler(self):

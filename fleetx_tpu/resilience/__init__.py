@@ -1,0 +1,13 @@
+"""Resilience layer: deterministic fault injection for chaos testing.
+
+The training sentry (core/engine.py), checkpoint fallback (Trainer.load),
+and serving admission control (serving/engine.py) are the *production*
+halves of the resilience story; this package holds the test half — a
+deterministic, env/config-driven fault injector (``faults.py``) whose
+injection points are compiled into the hot paths but cost one global
+flag check when inert. docs/RESILIENCE.md has the full tour.
+"""
+
+from fleetx_tpu.resilience.faults import FaultPlan, faults
+
+__all__ = ["FaultPlan", "faults"]

@@ -1,0 +1,236 @@
+"""Deterministic fault injection for the resilience chaos suite.
+
+One process-global :class:`FaultInjector` (``faults``) owns a
+:class:`FaultPlan` parsed from ``FLEETX_FAULT_*`` env vars (or installed
+programmatically via :meth:`FaultInjector.configure`). Production code
+carries the injection points — the Trainer wraps its train-data iterator
+in :meth:`wrap_train_data` and calls :meth:`on_checkpoint_save` before
+every checkpoint write — but when no plan is active every hook is a
+single ``is None`` check, so an unconfigured run is byte-identical to a
+build without this module.
+
+Injection points (all batch indices count *fetched* train batches across
+the whole run, independent of whether the sentry later skipped the step —
+that keeps the injection deterministic under skip/resume):
+
+- ``FLEETX_FAULT_NAN_BATCH``: poison every floating-point leaf of the
+  matching train batches with NaN (the classic bad-shard/corrupt-record
+  failure that turns the loss and every grad NaN).
+- ``FLEETX_FAULT_DATA_RAISE_BATCH``: the data iterator raises
+  ``DataFault`` instead of yielding the matching batch (a dead shard /
+  filesystem error mid-epoch).
+- ``FLEETX_FAULT_DATA_SLOW_BATCH`` / ``FLEETX_FAULT_DATA_SLOW_S``:
+  sleep before yielding the matching batch (input-pipeline stall).
+- ``FLEETX_FAULT_CKPT_SAVE_STEP``: ``Trainer.save`` raises ``CkptFault``
+  at the matching step numbers (full disk / flaky object store).
+
+Batch/step selectors share one grammar: a comma-separated list of
+entries, each either an int (``"3"``), or ``"N+"`` for every index >= N
+(``"0+"`` = always). :func:`raising_on_token` builds the deterministic
+raising streaming callback the serving chaos scenarios use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Iterable, Optional
+
+import numpy as np
+
+__all__ = [
+    "CkptFault",
+    "DataFault",
+    "FaultInjector",
+    "FaultPlan",
+    "faults",
+    "raising_on_token",
+]
+
+
+class DataFault(RuntimeError):
+    """Injected data-iterator failure (FLEETX_FAULT_DATA_RAISE_BATCH)."""
+
+
+class CkptFault(IOError):
+    """Injected checkpoint-write failure (FLEETX_FAULT_CKPT_SAVE_STEP)."""
+
+
+class _Selector:
+    """Index selector: ``"3"``, ``"1,4"``, ``"2+"`` (every index >= 2)."""
+
+    def __init__(self, spec: str):
+        self.exact = set()
+        self.from_ = None  # smallest N of any "N+" entry
+        for part in str(spec).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if part.endswith("+"):
+                n = int(part[:-1])
+                self.from_ = n if self.from_ is None else min(self.from_, n)
+            else:
+                self.exact.add(int(part))
+
+    def __contains__(self, i: int) -> bool:
+        return i in self.exact or (self.from_ is not None and i >= self.from_)
+
+    def __bool__(self) -> bool:
+        return bool(self.exact) or self.from_ is not None
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """Parsed fault schedule (module docstring has the env grammar)."""
+
+    nan_batch: Optional[str] = None
+    data_raise_batch: Optional[str] = None
+    data_slow_batch: Optional[str] = None
+    data_slow_s: float = 0.05
+    ckpt_save_step: Optional[str] = None
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> Optional["FaultPlan"]:
+        """Build a plan from ``FLEETX_FAULT_*`` (None when none are set).
+        Malformed values raise a ValueError naming the offending var — a
+        chaos run must fail loudly, never silently skip its faults."""
+        slow_s = 0.05
+        raw = env.get("FLEETX_FAULT_DATA_SLOW_S")
+        if raw:
+            try:
+                slow_s = float(raw)
+            except ValueError:
+                raise ValueError(
+                    f"FLEETX_FAULT_DATA_SLOW_S={raw!r} is not a float")
+        plan = cls(
+            nan_batch=env.get("FLEETX_FAULT_NAN_BATCH") or None,
+            data_raise_batch=env.get("FLEETX_FAULT_DATA_RAISE_BATCH") or None,
+            data_slow_batch=env.get("FLEETX_FAULT_DATA_SLOW_BATCH") or None,
+            data_slow_s=slow_s,
+            ckpt_save_step=env.get("FLEETX_FAULT_CKPT_SAVE_STEP") or None,
+        )
+        if not (plan.nan_batch or plan.data_raise_batch
+                or plan.data_slow_batch or plan.ckpt_save_step):
+            return None
+        return plan
+
+
+class FaultInjector:
+    """Process-global injector: holds the active plan + fetch counters."""
+
+    def __init__(self):
+        self._plan: Optional[FaultPlan] = None
+        self._nan_sel = self._raise_sel = self._slow_sel = self._ckpt_sel = None
+        self._batch_counter = 0
+        self.injected = {"nan": 0, "data_raise": 0, "data_slow": 0, "ckpt": 0}
+
+    # ----------------------------------------------------------- configure
+    def configure(self, plan: Optional[FaultPlan] = None, **kw) -> None:
+        """Install ``plan`` (or build one from kwargs); resets counters."""
+        if plan is None and kw:
+            plan = FaultPlan(**{k: str(v) if v is not None
+                                and k.endswith(("batch", "step")) else v
+                                for k, v in kw.items()})
+        def sel(field):
+            spec = getattr(plan, field, None) if plan else None
+            if not spec:
+                return None
+            try:
+                return _Selector(spec)
+            except ValueError:
+                raise ValueError(
+                    f"FLEETX_FAULT_{field.upper()}={spec!r}: selector "
+                    "entries must be ints like '3', '1,4', or '2+'")
+
+        self._plan = plan
+        self._nan_sel = sel("nan_batch")
+        self._raise_sel = sel("data_raise_batch")
+        self._slow_sel = sel("data_slow_batch")
+        self._ckpt_sel = sel("ckpt_save_step")
+        self._batch_counter = 0
+        self.injected = {"nan": 0, "data_raise": 0, "data_slow": 0, "ckpt": 0}
+
+    def configure_from_env(self, env=os.environ) -> None:
+        """Re-read ``FLEETX_FAULT_*`` into the active plan."""
+        self.configure(FaultPlan.from_env(env))
+
+    def reset(self) -> None:
+        """Deactivate all faults and zero the counters."""
+        self.configure(None)
+
+    @property
+    def active(self) -> bool:
+        """True when any fault is scheduled."""
+        return self._plan is not None
+
+    # ------------------------------------------------------ injection points
+    def wrap_train_data(self, data: Iterable) -> Iterable:
+        """Route a train-data iterable through the data faults. Returns
+        ``data`` unchanged when inert; the fetch counter is global across
+        epochs (each wrap continues where the previous left off)."""
+        if self._plan is None:
+            return data
+
+        def gen():
+            for batch in data:
+                i = self._batch_counter
+                self._batch_counter += 1
+                if self._raise_sel and i in self._raise_sel:
+                    self.injected["data_raise"] += 1
+                    raise DataFault(f"injected data failure at batch {i} "
+                                    "(FLEETX_FAULT_DATA_RAISE_BATCH)")
+                if self._slow_sel and i in self._slow_sel:
+                    self.injected["data_slow"] += 1
+                    time.sleep(self._plan.data_slow_s)
+                if self._nan_sel and i in self._nan_sel:
+                    batch = self._poison(batch, i)
+                yield batch
+
+        return gen()
+
+    def _poison(self, batch, i: int):
+        """NaN-fill every floating-point leaf of a dict batch (copy)."""
+        out, hit = {}, False
+        for k, v in batch.items():
+            arr = np.asarray(v)
+            if np.issubdtype(arr.dtype, np.floating):
+                arr = np.full_like(arr, np.nan)
+                hit = True
+            out[k] = arr
+        if not hit:
+            raise ValueError(
+                f"FLEETX_FAULT_NAN_BATCH: batch {i} has no floating-point "
+                "leaf to poison (keys: " + ", ".join(batch) + ")")
+        self.injected["nan"] += 1
+        return out
+
+    def on_checkpoint_save(self, step: int) -> None:
+        """Raise :class:`CkptFault` when ``step`` matches the plan."""
+        if self._ckpt_sel and step in self._ckpt_sel:
+            self.injected["ckpt"] += 1
+            raise CkptFault(f"injected checkpoint-write failure at step "
+                            f"{step} (FLEETX_FAULT_CKPT_SAVE_STEP)")
+
+
+def raising_on_token(after_tokens: int = 1, record: Optional[list] = None):
+    """Streaming callback that raises once its request has received
+    ``after_tokens`` tokens — the deterministic bad-user-callback fault
+    for the serving chaos scenarios. Tokens seen before the raise are
+    appended to ``record`` (as ``(request_id, token, finished)``)."""
+    seen = {"n": 0}
+
+    def cb(request_id: int, token: int, finished: bool) -> None:
+        seen["n"] += 1
+        if record is not None:
+            record.append((request_id, token, finished))
+        if seen["n"] >= after_tokens:
+            raise RuntimeError(
+                f"injected on_token failure (request {request_id}, "
+                f"token #{seen['n']})")
+
+    return cb
+
+
+faults = FaultInjector()
+faults.configure_from_env()

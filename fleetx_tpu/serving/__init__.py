@@ -18,11 +18,17 @@ observability). docs/SERVING.md has the architecture tour.
 """
 
 from fleetx_tpu.serving.cache_manager import SlotKVCacheManager, scatter_slot
-from fleetx_tpu.serving.engine import ServingEngine, ServingResult, sample_tokens
+from fleetx_tpu.serving.engine import (
+    QueueFull,
+    ServingEngine,
+    ServingResult,
+    sample_tokens,
+)
 from fleetx_tpu.serving.metrics import ServingMetrics
 from fleetx_tpu.serving.scheduler import FIFOScheduler, Request
 
 __all__ = [
+    "QueueFull",
     "ServingEngine",
     "ServingResult",
     "SlotKVCacheManager",

@@ -26,6 +26,18 @@ attended (see ``cache_manager.py``).
 Unsupported request shapes (beam search, repetition penalty, forced
 EOS/BOS) raise at construction/submit — they need cross-step state the
 slot loop does not carry; use the one-shot ``generate()`` for those.
+
+Admission control & deadlines (docs/RESILIENCE.md): the queue is bounded
+(``FLEETX_SERVING_MAX_QUEUE``, 0 = unbounded) and a full queue REJECTS
+at submit with :class:`QueueFull` — explicit backpressure the caller can
+act on, instead of unbounded growth under overload. Per-request
+``queue_ttl_s`` (time waiting for a slot) and ``deadline_s`` (total
+submit→finish lifetime) retire requests with ``finish_reason="timeout"``;
+``cancel(request_id)`` frees a queued or in-flight request's slot
+immediately. A raising ``on_token`` callback retires only ITS request
+(``finish_reason="error"``) — neighbors' token streams are untouched.
+With no limits configured every knob is inert and token outputs are
+byte-identical to the unlimited engine.
 """
 
 from __future__ import annotations
@@ -51,9 +63,15 @@ from fleetx_tpu.serving.metrics import ServingMetrics
 from fleetx_tpu.serving.scheduler import FIFOScheduler, Request
 from fleetx_tpu.utils.log import logger
 
-__all__ = ["ServingEngine", "ServingResult", "sample_tokens"]
+__all__ = ["QueueFull", "ServingEngine", "ServingResult", "sample_tokens"]
 
 _NEG = -1e9
+
+
+class QueueFull(RuntimeError):
+    """Admission refused: the queue is at ``FLEETX_SERVING_MAX_QUEUE``.
+    The explicit backpressure signal — callers shed load or retry later;
+    the engine never buffers unboundedly under overload."""
 
 
 def _env_int(name: str, default: int) -> int:
@@ -61,6 +79,19 @@ def _env_int(name: str, default: int) -> int:
         return int(os.environ.get(name, default))
     except ValueError:
         return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _deactivate(st, slot):
+    # clear one slot's active lane; its row still rides the batched decode
+    # step (outputs discarded) exactly like any other free slot
+    return {**st, "active": st["active"].at[slot].set(False)}
 
 
 def sample_tokens(logits, keys, greedy, temperature, top_k, top_p, *,
@@ -99,7 +130,7 @@ class ServingResult:
     id: int
     prompt: np.ndarray
     tokens: np.ndarray  # generated tokens (EOS included when hit)
-    finish_reason: str  # eos | max_length | cache_full
+    finish_reason: str  # eos | max_length | cache_full | timeout | cancelled | error
     ttft_s: float
     latency_s: float
 
@@ -119,7 +150,10 @@ class ServingEngine:
                  base_seed: int = 0, topk_cap: Optional[int] = None,
                  prefill_bucket: Optional[int] = None,
                  log_every: Optional[int] = None,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 max_queue: Optional[int] = None,
+                 queue_ttl_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None):
         gen_cfg = gen_cfg or GenerationConfig(decode_strategy="greedy")
         if gen_cfg.repetition_penalty != 1.0:
             raise ValueError("continuous batching does not support "
@@ -147,6 +181,16 @@ class ServingEngine:
                                or _env_int("FLEETX_SERVING_PREFILL_BUCKET", 32))
         self.log_every = (log_every if log_every is not None
                           else _env_int("FLEETX_SERVING_LOG_EVERY", 0))
+        # admission control (module docstring): all default OFF — an
+        # engine with no limits configured behaves byte-identically to the
+        # pre-resilience engine
+        self.max_queue = (max_queue if max_queue is not None
+                          else _env_int("FLEETX_SERVING_MAX_QUEUE", 0))
+        self.queue_ttl_s = (queue_ttl_s if queue_ttl_s is not None
+                            else _env_float("FLEETX_SERVING_QUEUE_TTL_S", 0.0))
+        self.deadline_s = (deadline_s if deadline_s is not None
+                           else _env_float("FLEETX_SERVING_DEADLINE_S", 0.0))
+        self._now = time.perf_counter  # swappable clock (chaos tests)
         self.cache_manager = SlotKVCacheManager(self.model, self.slots,
                                                 cache_len)
         self.scheduler = FIFOScheduler()
@@ -167,6 +211,7 @@ class ServingEngine:
             self._decode_fn, static_argnums=(3,),
             donate_argnums=(1, 2) if donate else ())
         self._admit_jit = jax.jit(self._admit_fn, donate_argnums=())
+        self._deactivate_jit = jax.jit(_deactivate)
         self._prefill_jits = {}  # bucketed prompt length -> jitted prefill
         self._donate_cache = donate
 
@@ -179,11 +224,27 @@ class ServingEngine:
                temperature: Optional[float] = None,
                top_k: Optional[int] = None, top_p: Optional[float] = None,
                seed: Optional[int] = None, rng_key: Optional[jax.Array] = None,
-               on_token=None) -> int:
+               on_token=None, queue_ttl_s: Optional[float] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Queue one request; returns its id. Kwargs override the engine's
         ``gen_cfg`` defaults per request; ``seed`` (or a raw ``rng_key``)
         pins this request's private sampling stream, ``on_token`` streams
-        ``(request_id, token, finished)`` per decoded token."""
+        ``(request_id, token, finished)`` per decoded token.
+        ``queue_ttl_s``/``deadline_s`` override the engine's admission
+        limits (0 disables). Raises :class:`QueueFull` when the bounded
+        queue is at ``FLEETX_SERVING_MAX_QUEUE``."""
+        if self.max_queue and self.scheduler.queue_depth >= self.max_queue:
+            # dead entries must not hold live ones out: sweep TTL/deadline
+            # expiries before judging the bound (step() normally does this,
+            # but a submit burst between ticks sees the stale depth)
+            self._expire_queued(self._now())
+        if self.max_queue and self.scheduler.queue_depth >= self.max_queue:
+            self.metrics.record_reject()
+            raise QueueFull(
+                f"admission queue is full ({self.scheduler.queue_depth}/"
+                f"{self.max_queue} waiting, {self.cache_manager.active_count}"
+                f"/{self.slots} slots busy); retry later or raise "
+                "FLEETX_SERVING_MAX_QUEUE")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -233,15 +294,21 @@ class ServingEngine:
             top_k=tk,
             top_p=float(top_p if top_p is not None else g.top_p),
             rng_key=rng_key, on_token=on_token,
-            submit_time=time.perf_counter(),
+            submit_time=self._now(),
+            queue_ttl_s=float(queue_ttl_s if queue_ttl_s is not None
+                              else self.queue_ttl_s),
+            deadline_s=float(deadline_s if deadline_s is not None
+                             else self.deadline_s),
         )
         self.scheduler.submit(req)
         self.metrics.record_submit()
         return rid
 
     def step(self) -> Dict:
-        """One scheduler tick: admissions, one batched decode step,
-        retirements. Returns a small summary dict."""
+        """One scheduler tick: queued-expiry sweep, admissions, one batched
+        decode step, retirements, active-deadline sweep. Returns a small
+        summary dict (``timed_out`` lists this tick's deadline victims)."""
+        timed_out = self._expire_queued(self._now())
         admitted = 0
         while self.cache_manager.free_count and len(self.scheduler):
             self._admit(self.scheduler.pop_next())
@@ -250,14 +317,62 @@ class ServingEngine:
         retired = []
         if decoded:
             retired = self._tick_decode()
+        # fresh clock: prefill/decode above may have eaten the deadline
+        timed_out += self._expire_active(self._now())
         self._ticks += 1
         self.metrics.observe_tick(self.scheduler.queue_depth,
                                   len(self._active))
         if self.log_every and self._ticks % self.log_every == 0:
             self.metrics.log_snapshot()
-        return {"admitted": admitted, "decoded": decoded, "retired": retired,
+        return {"admitted": admitted, "decoded": decoded,
+                "retired": retired + timed_out, "timed_out": timed_out,
                 "queue_depth": self.scheduler.queue_depth,
                 "active_slots": len(self._active)}
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a queued or in-flight request: its slot (if any) is freed
+        for the next admission THIS instant and its partial output is
+        recorded with ``finish_reason="cancelled"``. Returns False when the
+        id is unknown or already finished."""
+        now = self._now()
+        req = self.scheduler.remove(request_id)
+        if req is None:
+            for r in self._active.values():
+                if r.id == request_id:
+                    req = r
+                    break
+        if req is None:
+            return False
+        self._evict(req, "cancelled", now)
+        return True
+
+    def _expire_queued(self, now):
+        """Retire queued requests whose queue-TTL/deadline passed (they
+        never get a slot; ``finish_reason="timeout"``, empty tokens)."""
+        out = []
+        for req in self.scheduler.pop_expired(now):
+            self._finalize(req, "timeout", now)
+            out.append(req.id)
+        return out
+
+    def _expire_active(self, now):
+        """Retire in-flight requests past their total deadline, freeing
+        their slots; partial tokens are kept in the result."""
+        out = []
+        for req in list(self._active.values()):
+            if req.deadline_s and now - req.submit_time > req.deadline_s:
+                self._evict(req, "timeout", now)
+                out.append(req.id)
+        return out
+
+    def _evict(self, req: Request, reason: str, now: float) -> None:
+        """THE mid-flight retirement path (cancel / deadline / callback
+        error): deactivate the request's decode lane on device if it holds
+        one, free the slot, record the partial result."""
+        if req.slot is not None:
+            self._state = self._deactivate_jit(
+                self._state, jnp.asarray(req.slot, jnp.int32))
+        self._finalize(req, reason, now)
 
     def drain(self, max_ticks: Optional[int] = None) -> Dict[int, ServingResult]:
         """Tick until queue and slots are empty (or ``max_ticks``), then
@@ -308,7 +423,21 @@ class ServingEngine:
                       np.int32)
         out[:, :prompt_len] = ids
         for i, rid in enumerate(rids):
-            toks = results[rid].tokens
+            res = results.get(rid)
+            if res is None:
+                # a retired-without-result request (timed out of the queue
+                # before this drain, cancelled concurrently, ...) must not
+                # crash the whole batch: its row stays pad, loudly
+                logger.error(
+                    "serving: generate_batch request %d (row %d) produced "
+                    "no result; row left as pad", rid, i)
+                continue
+            if res.finish_reason not in ("eos", "max_length", "cache_full"):
+                logger.warning(
+                    "serving: generate_batch request %d (row %d) retired "
+                    "with finish_reason=%r after %d token(s); rest of row "
+                    "is pad", rid, i, res.finish_reason, len(res.tokens))
+            toks = res.tokens
             out[i, prompt_len:prompt_len + len(toks)] = toks
         return jnp.asarray(out)
 
@@ -410,7 +539,7 @@ class ServingEngine:
         )
         self.cache_manager.cache = cache
         tok = int(tok)  # host sync: the first token is now observable
-        now = time.perf_counter()
+        now = self._now()
         req.admit_time = req.first_token_time = now
         req.tokens.append(tok)
         self.metrics.record_admit(now - req.submit_time)
@@ -418,8 +547,6 @@ class ServingEngine:
         self.metrics.record_tokens(1)
         done_eos = req.eos_token_id >= 0 and tok == req.eos_token_id
         done = done_eos or req.max_new_tokens <= 1
-        if req.on_token:
-            req.on_token(req.id, tok, done)
         self._state = self._admit_jit(
             self._state, jnp.asarray(slot, jnp.int32),
             jnp.asarray(tok, jnp.int32),
@@ -434,7 +561,13 @@ class ServingEngine:
             jnp.asarray(req.top_p, jnp.float32),
             carry_key,
         )
-        if done:
+        # callback AFTER the device state is consistent: a raising callback
+        # then retires exactly this request and can't leave the slot half-
+        # installed (previously it unwound _admit between cache scatter and
+        # state install)
+        if not self._emit_token(req, tok, done):
+            self._retire_error(req, now)
+        elif done:
             self._finalize(req, "eos" if done_eos else "max_length", now)
         else:
             self._active[slot] = req
@@ -490,7 +623,7 @@ class ServingEngine:
         self._state = st
         tok_np = np.asarray(tok)  # host sync per tick
         done_np = np.asarray(done)
-        now = time.perf_counter()
+        now = self._now()
         retired = []
         for slot, req in list(self._active.items()):
             t = int(tok_np[slot])
@@ -498,8 +631,13 @@ class ServingEngine:
             self.cache_manager.lengths[slot] += 1
             self.metrics.record_tokens(1)
             finished = bool(done_np[slot])
-            if req.on_token:
-                req.on_token(req.id, t, finished)
+            # firewalled callback: a raising on_token retires THIS request
+            # only — every neighbor's host token list was already appended
+            # this tick and keeps decoding undisturbed
+            if not self._emit_token(req, t, finished):
+                self._retire_error(req, now)
+                retired.append(req.id)
+                continue
             if finished:
                 if req.eos_token_id >= 0 and t == req.eos_token_id:
                     reason = "eos"
@@ -511,10 +649,30 @@ class ServingEngine:
                 retired.append(req.id)
         return retired
 
+    def _emit_token(self, req: Request, tok: int, finished: bool) -> bool:
+        """Invoke a request's streaming callback behind a firewall; False
+        means the callback raised (the caller retires the request with
+        ``finish_reason="error"``)."""
+        if not req.on_token:
+            return True
+        try:
+            req.on_token(req.id, tok, finished)
+            return True
+        except Exception:
+            logger.exception(
+                "serving: request %d on_token callback raised; retiring it "
+                "with finish_reason='error' (other slots unaffected)", req.id)
+            return False
+
+    def _retire_error(self, req: Request, now: float) -> None:
+        """Retire one request whose callback raised."""
+        self._evict(req, "error", now)
+
     def _finalize(self, req: Request, reason: str, now: float) -> None:
         if req.slot in self._active:
             del self._active[req.slot]
-        self.cache_manager.free(req.slot)
+        if req.slot is not None:  # queued-expiry/cancel never held a slot
+            self.cache_manager.free(req.slot)
         self.metrics.record_retire(now - req.submit_time, reason)
         self._results[req.id] = ServingResult(
             id=req.id, prompt=req.prompt,

@@ -37,6 +37,7 @@ class ServingMetrics:
         self.submitted = 0
         self.admitted = 0
         self.retired = 0
+        self.rejected = 0
         self.tokens_generated = 0
         self.ticks = 0
         self.finish_reasons: Dict[str, int] = {}
@@ -72,11 +73,33 @@ class ServingMetrics:
         self._last_token_t = now
         self.tokens_generated += n
 
+    def record_reject(self) -> None:
+        """A submit was refused by admission control (queue full)."""
+        self.rejected += 1
+
     def record_retire(self, latency_s: float, reason: str) -> None:
-        """A request finished (``reason``: eos | max_length | cache_full)."""
+        """A request finished (``reason``: eos | max_length | cache_full |
+        timeout | cancelled | error)."""
         self.retired += 1
         self.latency_s.append(float(latency_s))
         self.finish_reasons[reason] = self.finish_reasons.get(reason, 0) + 1
+
+    # admission-control counters are views over finish_reasons — one source
+    # of truth, no parallel state to drift
+    @property
+    def timeouts(self) -> int:
+        """Requests retired by queue-TTL or total-deadline expiry."""
+        return self.finish_reasons.get("timeout", 0)
+
+    @property
+    def cancels(self) -> int:
+        """Requests retired via ``cancel()``."""
+        return self.finish_reasons.get("cancelled", 0)
+
+    @property
+    def callback_errors(self) -> int:
+        """Requests retired because their ``on_token`` callback raised."""
+        return self.finish_reasons.get("error", 0)
 
     def observe_tick(self, queue_depth: int, active_slots: int) -> None:
         """Per-tick gauge sample from the engine's scheduler loop."""
@@ -97,6 +120,10 @@ class ServingMetrics:
             "submitted": self.submitted,
             "admitted": self.admitted,
             "retired": self.retired,
+            "rejected": self.rejected,
+            "timeouts": self.timeouts,
+            "cancels": self.cancels,
+            "callback_errors": self.callback_errors,
             "tokens_generated": self.tokens_generated,
             "ticks": self.ticks,
             "queue_depth": self.queue_depth,
@@ -128,10 +155,12 @@ class ServingMetrics:
 
         s = self.snapshot()
         logger.info(
-            "serving: queue=%d active=%d/%d retired=%d/%d tokens=%d "
+            "serving: queue=%d active=%d/%d retired=%d/%d rejected=%d "
+            "timeouts=%d cancels=%d tokens=%d "
             "occupancy=%.2f tok/s=%s ttft_ms_p50=%s",
             s["queue_depth"], s["active_slots"], s["slots"], s["retired"],
-            s["submitted"], s["tokens_generated"], s["slot_occupancy_mean"],
+            s["submitted"], s["rejected"], s["timeouts"], s["cancels"],
+            s["tokens_generated"], s["slot_occupancy_mean"],
             ("%.1f" % s["decode_tokens_per_s"]
              if s["decode_tokens_per_s"] else "-"),
             ("%.1f" % s["ttft_ms_p50"] if s["ttft_ms_p50"] else "-"),

@@ -41,6 +41,11 @@ class Request:
     rng_key: jax.Array
     on_token: Optional[Callable[[int, int, bool], None]] = None
     submit_time: float = 0.0
+    # admission-control limits, resolved by the engine at submit (0 = off):
+    # queue_ttl_s bounds time WAITING for a slot, deadline_s bounds the
+    # whole submit->finish lifetime; both retire as finish_reason="timeout"
+    queue_ttl_s: float = 0.0
+    deadline_s: float = 0.0
     # filled in by the engine over the request's lifecycle
     slot: Optional[int] = None
     admit_time: Optional[float] = None
@@ -66,6 +71,32 @@ class FIFOScheduler:
     def pop_next(self) -> Optional[Request]:
         """Next request to admit (None when the queue is empty)."""
         return self._queue.popleft() if self._queue else None
+
+    def remove(self, request_id: int) -> Optional[Request]:
+        """Pull one queued request out by id (None if not queued) — the
+        cancel() path for requests that never won a slot."""
+        for r in self._queue:
+            if r.id == request_id:
+                self._queue.remove(r)
+                return r
+        return None
+
+    def pop_expired(self, now: float) -> List[Request]:
+        """Remove and return every queued request whose queue-TTL or total
+        deadline has passed at ``now``. Arrival order is preserved for the
+        survivors; a queue with no limits configured costs one scan."""
+        if not any(r.queue_ttl_s or r.deadline_s for r in self._queue):
+            return []
+        dead, keep = [], collections.deque()
+        for r in self._queue:
+            waited = now - r.submit_time
+            if ((r.queue_ttl_s and waited > r.queue_ttl_s)
+                    or (r.deadline_s and waited > r.deadline_s)):
+                dead.append(r)
+            else:
+                keep.append(r)
+        self._queue = keep
+        return dead
 
     def __len__(self) -> int:
         return len(self._queue)

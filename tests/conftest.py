@@ -31,6 +31,21 @@ if not _REAL:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    """Register the suite's markers (no pytest.ini in this repo).
+
+    ``chaos`` — deterministic fault-injection resilience tests
+    (tests/test_resilience.py). They run on CPU in seconds and stay
+    INSIDE the tier-1 ``-m 'not slow'`` selection by design: resilience
+    regressions should fail the same gate as correctness regressions.
+    ``slow`` — opt-out marker the tier-1 selection excludes."""
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection resilience test "
+        "(fast, CPU, part of tier-1)")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 'not slow' selection")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     import jax

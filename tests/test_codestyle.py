@@ -57,6 +57,31 @@ def test_repo_tree_is_clean():
     assert r.returncode == 0, r.stdout[-1500:]
 
 
+def test_env_vars_documented():
+    """Drift gate (ISSUE 5): every ``FLEETX_*`` env var mentioned under
+    fleetx_tpu/ and tools/ must appear in docs/ENV_VARS.md — this issue
+    found FLEETX_FLASH_BLOCK_K read in ops/pallas/flash_attention.py but
+    absent from the doc, and this test keeps that class of drift out."""
+    import glob
+    import re
+
+    with open(os.path.join(REPO, "docs", "ENV_VARS.md")) as f:
+        doc = f.read()
+    reads = set()
+    for pat in ("fleetx_tpu/**/*.py", "tools/**/*.py"):
+        for path in glob.glob(os.path.join(REPO, pat), recursive=True):
+            with open(path) as f:
+                src = f.read()
+            # trailing [A-Z0-9]: an f-string prefix like "FLEETX_FLASH_"
+            # (dynamic name) reduces to its stem, which the doc's real
+            # entries cover as a substring
+            reads |= set(re.findall(r"FLEETX_[A-Z0-9_]*[A-Z0-9]", src))
+    missing = sorted(v for v in reads if v not in doc)
+    assert not missing, (
+        f"env vars read in code but undocumented in docs/ENV_VARS.md: "
+        f"{missing}")
+
+
 def test_shell_scripts_parse():
     """bash -n over every launch/benchmark script (the reference gates its
     shell surface through CI runs; we gate syntax statically)."""

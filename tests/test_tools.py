@@ -103,6 +103,24 @@ def test_bench_serving_records_schema(monkeypatch):
     assert static["detail"]["generated_tokens"] >= static["detail"]["useful_tokens"]
 
 
+def test_chaos_check_sentry_scenario(tmp_path):
+    """The chaos smoke driver's sentry scenario passes in-process (the
+    full sweep is tests/test_resilience.py; this proves the CLI works)."""
+    sys.path.insert(0, REPO)
+    import tools.chaos_check as cc
+
+    rc = cc.main(["--only", "sentry", "--workdir", str(tmp_path)])
+    assert rc == 0
+
+
+def test_chaos_check_unknown_scenario_fails(tmp_path):
+    """An unknown scenario name is a non-zero exit, not a silent pass."""
+    sys.path.insert(0, REPO)
+    import tools.chaos_check as cc
+
+    assert cc.main(["--only", "nope", "--workdir", str(tmp_path)]) == 1
+
+
 def test_precomputed_embeddings_feed_text_image_dataset(tmp_path):
     """The tool's output is directly mmap-consumable by TextImageDataset."""
     sys.path.insert(0, REPO)

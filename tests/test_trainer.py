@@ -278,3 +278,70 @@ def test_preemption_sigterm_checkpoints_and_resumes(tmp_path, eight_devices):
     trainer2 = Trainer(cfg, module2)
     trainer2.init_state(data[0])  # resumable dir -> restores in init_state
     assert int(trainer2.state.step) == saved_step
+
+
+def test_sigterm_with_pending_async_save_finalizes(tmp_path, eight_devices):
+    """SIGTERM arriving while a periodic async save is still in flight:
+    the grace-window save must finalize BOTH checkpoints (no
+    *.orbax-checkpoint-tmp debris) and resume must be step-exact."""
+    import os
+    import pathlib
+    import signal
+
+    cfg = _cfg(tmp_path)
+    cfg.Engine.max_steps = 50
+    cfg.Engine.save_load.save_steps = 2  # async save at step 2 ...
+
+    module = build_module(cfg)
+    trainer = Trainer(cfg, module)
+    data = _batches(cfg, 4)
+
+    class SignalAfter:
+        """Delivers SIGTERM right after the step-2 async save started."""
+
+        def __iter__(self):
+            for i, b in enumerate(data * 20):
+                if i == 3:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                yield b
+
+    trainer.fit(SignalAfter())
+    assert trainer._preempted
+    saved_step = int(trainer.state.step)
+    assert saved_step == 3  # preemption save, after the step-2 periodic one
+    out = pathlib.Path(cfg.Engine.save_load.output_dir)
+    leftovers = list(out.rglob("*.orbax-checkpoint-tmp*"))
+    assert not leftovers, leftovers  # every async save finalized
+
+    trainer2 = Trainer(cfg, build_module(cfg))
+    trainer2.init_state(data[0])
+    assert int(trainer2.state.step) == saved_step
+
+
+def test_sentry_skip_resume_epoch_and_consumed_samples(tmp_path, eight_devices):
+    """A sentry-skipped step still consumed its batch: after save/restore
+    the resumed trainer reports the skipped batch in consumed_samples and
+    the step counter reflects only applied updates."""
+    from fleetx_tpu.resilience.faults import faults
+
+    cfg = _cfg(tmp_path)
+    cfg.Engine.max_steps = 4
+    module = build_module(cfg)
+    trainer = Trainer(cfg, module)
+    data = _batches(cfg, 5)
+    faults.configure(nan_batch="2")
+    try:
+        trainer.fit(data)
+    finally:
+        faults.reset()
+    assert trainer.sentry_skips == 1
+    assert int(trainer.state.step) == 4  # 4 applied updates from 5 batches
+    gbs = cfg.Global.global_batch_size
+    assert trainer.consumed_samples == 5 * gbs
+    trainer.save(epoch=0)
+
+    trainer2 = Trainer(cfg, build_module(cfg))
+    trainer2.init_state(data[0])  # resumable dir -> restores in init_state
+    assert int(trainer2.state.step) == 4
+    assert trainer2.consumed_samples == 5 * gbs  # skipped batch not re-fed
+    assert trainer2.start_epoch == 0

@@ -97,7 +97,11 @@ def offline_eval(cfg):
         ) from None
     trainer.init_state(first)
     if (cfg.Engine.save_load or {}).get("ckpt_dir"):
-        trainer.load()
+        if not trainer.load():
+            raise SystemExit(
+                "eval: no restorable checkpoint under ckpt_dir "
+                f"{cfg.Engine.save_load.ckpt_dir!r} — evaluating unrestored "
+                "params would report a meaningless loss")
     result = module.evaluate_dataset(
         trainer.state.params, _batched(ds, batch_size)
     )
@@ -118,7 +122,11 @@ def main():
     first = next(iter(loader))
     trainer.init_state(first)
     if (cfg.Engine.save_load or {}).get("ckpt_dir"):
-        trainer.load()
+        if not trainer.load():
+            raise SystemExit(
+                "eval: no restorable checkpoint under ckpt_dir "
+                f"{cfg.Engine.save_load.ckpt_dir!r} — evaluating unrestored "
+                "params would report a meaningless loss")
     loss = trainer.evaluate(loader)
     logger.info("eval loss: %s", loss)
 

@@ -33,7 +33,13 @@ def main():
     }
     trainer.init_state(sample)
     if (cfg.Engine.save_load or {}).get("ckpt_dir"):
-        trainer.load()
+        if not trainer.load():
+            # exporting whatever init_state left (random/pretrained) would
+            # silently ship untrained weights with exit code 0
+            raise SystemExit(
+                "export: no restorable checkpoint under ckpt_dir "
+                f"{cfg.Engine.save_load.ckpt_dir!r} (corrupt ones are "
+                "quarantined); refusing to export unrestored params")
     out = (cfg.Engine.save_load or {}).get("output_dir") or "./exported"
     # QAT configs export int8 weights (reference quantized export,
     # eager_engine.py:734-745); serving dequantizes transparently

@@ -33,6 +33,10 @@ def main():
     if (cfg.Engine.save_load or {}).get("ckpt_dir"):
         first = next(iter(train_loader))
         trainer.init_state(first)
+        # a first launch (no checkpoint yet) trains from scratch; if
+        # checkpoints exist but NONE restores, load() raises
+        # CheckpointUnrestorable so an auto-restarting job dies loudly
+        # instead of silently retraining from step 0
         trainer.load()
         train_loader.batch_sampler.consumed_samples = trainer.consumed_samples
     trainer.fit(train_loader, eval_loader)
