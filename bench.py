@@ -262,6 +262,7 @@ def main():
     # child either acquires and exits cleanly in seconds or proves the
     # wedge quickly. Skipped only when the platform override targets the
     # host CPU (nothing to probe there).
+    fallback = False
     if os.environ.get("BENCH_PLATFORM", "") != "cpu":
         import subprocess
 
@@ -273,10 +274,30 @@ def main():
             )
         except subprocess.TimeoutExpired as e:
             tail = (e.stderr or b"").decode("utf-8", "replace")[-300:]
+            if os.environ.get("BENCH_CPU_FALLBACK", "1") != "1":
+                sys.stderr.write(
+                    f"bench: device probe exceeded {probe_s}s (TPU tunnel "
+                    f"wedged?); aborting. probe stderr tail: {tail}\n")
+                sys.exit(3)
+            # r03-r05 banked NO hardware numbers when the tunnel wedged —
+            # a silent gap in the perf trajectory. Bank a tiny CPU record
+            # tagged backend: "cpu-interpret" instead: a liveness tracer
+            # proving the bench path still runs, never a perf claim
+            # (vs_baseline is nulled below). BENCH_CPU_FALLBACK=0 restores
+            # the old hard abort.
             sys.stderr.write(
                 f"bench: device probe exceeded {probe_s}s (TPU tunnel "
-                f"wedged?); aborting. probe stderr tail: {tail}\n")
-            sys.exit(3)
+                f"wedged?); banking a CPU-interpret fallback record. "
+                f"probe stderr tail: {tail}\n")
+            fallback = True
+            os.environ["BENCH_PLATFORM"] = "cpu"
+            # shrink to host-feasible work (345M fwd+bwd on CPU)
+            os.environ["BENCH_SEQ"] = os.environ.get(
+                "BENCH_FALLBACK_SEQ", "256")
+            os.environ["BENCH_BATCH"] = "1"
+            os.environ["BENCH_STEPS"] = "2"
+            os.environ["BENCH_WARMUP"] = "1"
+            os.environ["BENCH_EXTRA"] = "0"  # children would wedge too
 
     extras = []
     if os.environ.get("BENCH_EXTRA", "1") != "0":
@@ -321,6 +342,12 @@ def main():
             except Exception as e:  # e.g. OOM at 2x batch: keep the anchor
                 extras.append({"metric": f"gpt_345m_pretrain_b{second}",
                                "error": repr(e)})
+    if fallback:
+        anchor["vs_baseline"] = None  # a CPU number is not an A100 ratio
+        anchor["detail"]["backend"] = "cpu-interpret"
+        anchor["detail"]["note"] = (
+            "TPU tunnel probe timed out; tiny CPU fallback record banked "
+            "so the perf trajectory has no silent gap (BENCH_CPU_FALLBACK)")
     if extras:
         anchor["detail"]["extra_records"] = extras
     print(json.dumps(anchor))

@@ -162,7 +162,9 @@ def init_decode_cache(model, batch: int):
     ``beam_search()``, and the continuous-batching serving engine
     (fleetx_tpu/serving/) all start from this tree, so its layout
     ([batch, cache_len, heads, head_dim] per layer + a scalar
-    ``cache_index``) is defined in exactly one place."""
+    ``cache_index``; [num_pages, page_size, heads, head_dim] shared pages
+    when the model carries ``cfg.decode_num_pages``) is defined in exactly
+    one place."""
     cache_shapes = jax.eval_shape(
         lambda: model.init(
             jax.random.PRNGKey(0),
@@ -175,7 +177,7 @@ def init_decode_cache(model, batch: int):
 
 
 def decode_step(model, params, cache, input_ids, position_ids, kv_mask=None,
-                cache_positions=None):
+                cache_positions=None, block_tables=None):
     """One cached decode forward: ``(logits, new_cache)``.
 
     The single reusable step both the ``generate()`` loop body and the
@@ -183,7 +185,11 @@ def decode_step(model, params, cache, input_ids, position_ids, kv_mask=None,
     ``input_ids`` is the prefill case). ``cache_positions`` ([b] int32,
     optional) routes each row's kv write to its own offset — the
     continuous-batching path where slots sit at different decode depths;
-    None keeps the shared ``cache_index`` scalar (the one-shot loop)."""
+    None keeps the shared ``cache_index`` scalar (the one-shot loop).
+    ``block_tables`` ([b, pages_per_row] int32) comes along when the model
+    carries a paged decode cache (``cfg.decode_num_pages``): each row's
+    logical positions then live in the shared page pool at the physical
+    pages its table names (serving/cache_manager.py)."""
     logits, mut = model.apply(
         {"params": params, "cache": cache},
         input_ids,
@@ -191,6 +197,7 @@ def decode_step(model, params, cache, input_ids, position_ids, kv_mask=None,
         kv_mask,
         decode=True,
         cache_positions=cache_positions,
+        block_tables=block_tables,
         mutable=["cache"],
     )
     return logits, mut["cache"]

@@ -95,6 +95,16 @@ class GPTConfig:
     # (attention reads, beam reorders) scales with the actual decode span,
     # not the model's position ceiling.
     decode_cache_len: Optional[int] = None
+    # paged decode cache (serving/cache_manager.py): when decode_num_pages
+    # is set, decode-mode kv caches are ONE shared pool of
+    # [decode_num_pages, decode_page_size, heads, head_dim] pages instead
+    # of per-row [b, decode_cache_len, ...] buffers; each row addresses
+    # its logical [0, decode_cache_len) window through a block table of
+    # page indices (``block_tables`` threading). decode_page_size must be
+    # a multiple of 8 for the paged flash-decode kernel, and
+    # decode_cache_len a multiple of decode_page_size.
+    decode_num_pages: Optional[int] = None
+    decode_page_size: Optional[int] = None
     # fuse the LM head matmul + cross-entropy into the Pallas blockwise
     # kernel (ops/pallas/ce_loss.py): the [tokens, vocab] logits never
     # materialize. Opt-in; intended for mp=1 runs (a vocab-sharded
@@ -173,7 +183,7 @@ class SelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, attn_mask=None, *, deterministic=True, decode=False,
-                 cache_positions=None):
+                 cache_positions=None, block_tables=None):
         cfg = self.cfg
         h, nh, hd = cfg.hidden_size, cfg.num_attention_heads, cfg.head_dim
 
@@ -190,11 +200,35 @@ class SelfAttention(nn.Module):
         causal = True
         if decode:
             kv_pad_mask = attn_mask  # pre-causal-merge mask: left-pad layout
-            k, v, attn_mask, decode_end = self._update_cache(
-                k, v, attn_mask, cache_positions
+            k, v, attn_mask, decode_end, paged = self._update_cache(
+                k, v, attn_mask, cache_positions, block_tables
             )
             causal = False  # the cache mask encodes absolute-position causality
-            if decode_end is not None and self._flash_decode_ok(
+            if paged is not None:
+                # Page-granular cache (serving): k/v above are the RAW
+                # shared page pools. Single-query steps take the paged
+                # flash kernel (block table rides scalar prefetch, HBM
+                # traffic = the row's live pages); everything else gathers
+                # each row's logical buffer and joins the dense fallback.
+                from fleetx_tpu.ops.pallas.decode_attention import (
+                    flash_decode_paged_attention,
+                    paged_gather_kv,
+                )
+
+                tables = paged
+                if decode_end is not None and self._flash_decode_ok(
+                    kv_pad_mask, tables.shape[1] * cfg.decode_page_size,
+                    deterministic, tile_len=cfg.decode_page_size,
+                ):
+                    out = flash_decode_paged_attention(
+                        q, k, v, tables=tables, end=decode_end,
+                        starts=self._pad_starts(kv_pad_mask, q.shape[0]),
+                    )
+                    out = checkpoint_name(out, "core_attn_out")
+                    return self._out_proj(out)
+                k = paged_gather_kv(k, tables)
+                v = paged_gather_kv(v, tables)
+            elif decode_end is not None and self._flash_decode_ok(
                 kv_pad_mask, k.shape[1], deterministic
             ):
                 # Single-query fast path: the Pallas flash-decode kernel reads
@@ -264,7 +298,8 @@ class SelfAttention(nn.Module):
         out = attn_out_dense(cfg.hidden_size, cfg.dtype)(out)
         return checkpoint_name(out, "attn_out")
 
-    def _update_cache(self, k, v, attn_mask, cache_positions=None):
+    def _update_cache(self, k, v, attn_mask, cache_positions=None,
+                      block_tables=None):
         """Incremental decode: append this step's k/v at cache_index and
         build the absolute-position causal mask (query i at absolute position
         start+i may see cache positions <= start+i). Cache layout
@@ -279,11 +314,21 @@ class SelfAttention(nn.Module):
         advanced (to the max write end) so one-shot callers interleaving
         both styles stay consistent.
 
-        Returns ``(k, v, attn_mask, decode_end)``: ``decode_end`` is the
-        number of live cache positions after this step's write (the
+        When ``cfg.decode_num_pages`` is set the cache is page-granular and
+        ``block_tables`` ([b, pages_per_row] int32) must come along with
+        ``cache_positions`` — see :meth:`_update_paged_cache`.
+
+        Returns ``(k, v, attn_mask, decode_end, paged)``: ``decode_end`` is
+        the number of live cache positions after this step's write (the
         single-query flash-decode kernel's upper bound; per-row [b] under
         ``cache_positions``) — None during init and for multi-token
-        (prefill) calls, where the fast path does not apply."""
+        (prefill) calls, where the fast path does not apply. ``paged`` is
+        None on this contiguous layout (the paged branch returns the block
+        tables and RAW page pools instead of gathered buffers)."""
+        if self.cfg.decode_num_pages is not None:
+            return self._update_paged_cache(
+                k, v, attn_mask, cache_positions, block_tables
+            )
         is_init = not self.has_variable("cache", "cached_key")
         b, s, nh, hd = k.shape
         max_len = (self.cfg.decode_cache_len
@@ -327,10 +372,79 @@ class SelfAttention(nn.Module):
                 if attn_mask is None
                 else (attn_mask.astype(bool) & causal)
             )
-        return k, v, attn_mask, decode_end
+        return k, v, attn_mask, decode_end, None
+
+    def _update_paged_cache(self, k, v, attn_mask, cache_positions,
+                            block_tables):
+        """Page-granular decode cache write (``cfg.decode_num_pages`` set).
+
+        The cache leaves are ONE pool of ``[num_pages, page_size, nh, hd]``
+        shared pages; logical position ``p`` of row ``b`` lives at physical
+        page ``block_tables[b, p // page_size]``, offset ``p % page_size``.
+        This step's k/v rows scatter through the tables (positions clamped
+        to the logical capacity: bucket-tail/pinned writes land on the
+        row's LAST logical slot or — through a zeroed table entry — on the
+        reserved trash page 0, both beyond every live window; see
+        serving/cache_manager.py for the safety argument). The causal mask
+        is built over LOGICAL positions, so the dense fallback can consume
+        it after :func:`paged_gather_kv` unchanged.
+
+        Returns ``(k_pages, v_pages, attn_mask, decode_end, tables)``: raw
+        pools + tables so the caller picks paged-flash vs gather-dense
+        without materializing both."""
+        cfg = self.cfg
+        is_init = not self.has_variable("cache", "cached_key")
+        b, s, nh, hd = k.shape
+        ps = cfg.decode_page_size
+        if ps is None or ps % 8:
+            raise ValueError(
+                f"decode_page_size must be a multiple of 8, got {ps}")
+        max_len = (cfg.decode_cache_len if cfg.decode_cache_len is not None
+                   else cfg.max_position_embeddings)
+        if max_len % ps:
+            raise ValueError(
+                f"decode_cache_len {max_len} must be a multiple of "
+                f"decode_page_size {ps}")
+        ck = self.variable(
+            "cache", "cached_key", jnp.zeros,
+            (cfg.decode_num_pages, ps, nh, hd), k.dtype
+        )
+        cv = self.variable(
+            "cache", "cached_value", jnp.zeros,
+            (cfg.decode_num_pages, ps, nh, hd), v.dtype
+        )
+        idx = self.variable("cache", "cache_index", lambda: jnp.array(0, jnp.int32))
+        decode_end = None
+        paged = None
+        if not is_init:
+            if cache_positions is None or block_tables is None:
+                raise ValueError(
+                    "a paged decode cache needs cache_positions AND "
+                    "block_tables (the serving engine threads both)")
+            wpos = cache_positions.astype(jnp.int32)       # [b] write offsets
+            tables = block_tables.astype(jnp.int32)        # [b, n_pages_row]
+            pos = wpos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+            pos = jnp.minimum(pos, max_len - 1)            # [b, s] logical
+            page = jnp.take_along_axis(tables, pos // ps, axis=1)
+            ck.value = ck.value.at[page.reshape(-1), (pos % ps).reshape(-1)
+                                   ].set(k.reshape(b * s, nh, hd))
+            cv.value = cv.value.at[page.reshape(-1), (pos % ps).reshape(-1)
+                                   ].set(v.reshape(b * s, nh, hd))
+            idx.value = jnp.max(wpos) + s
+            if s == 1:
+                decode_end = wpos + 1  # [b]: per-row live logical length
+            k_pos = jnp.arange(max_len)
+            q_pos = wpos[:, None] + jnp.arange(s)[None, :]  # [b, s] logical
+            causal = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None, :, :]
+            attn_mask = (causal if attn_mask is None
+                         else attn_mask.astype(bool) & causal)
+            paged = tables
+            k, v = ck.value, cv.value
+        return k, v, attn_mask, decode_end, paged
 
     def _flash_decode_ok(self, kv_pad_mask, cache_len: int,
-                         deterministic: bool) -> bool:
+                         deterministic: bool, tile_len: Optional[int] = None
+                         ) -> bool:
         """Static dispatch check for the single-query flash-decode path.
 
         The kernel handles exactly the generation-loop mask shape: an
@@ -339,7 +453,11 @@ class SelfAttention(nn.Module):
         exactly this). Anything else — arbitrary masks, attention dropout,
         untileable cache lengths, an ambient multi-device mesh (the bare
         Pallas call would make GSPMD replicate the sharded operands) —
-        falls back to the dense XLA path."""
+        falls back to the dense XLA path.
+
+        ``tile_len`` is the buffer length the kernel must tile: the page
+        size on the paged path (one page is the DMA/gather unit there),
+        defaulting to ``cache_len`` on the contiguous path."""
         cfg = self.cfg
         if not cfg.use_flash_attention:
             return False
@@ -358,7 +476,8 @@ class SelfAttention(nn.Module):
         mesh = ambient_mesh()
         if mesh is not None and mesh.size > 1:
             return False
-        return decode_flash_supported(cache_len)
+        return decode_flash_supported(
+            cache_len if tile_len is None else tile_len)
 
     @staticmethod
     def _pad_starts(kv_pad_mask, batch: int):
@@ -423,14 +542,14 @@ class DecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, attn_mask=None, deterministic=True, decode=False,
-                 cache_positions=None):
+                 cache_positions=None, block_tables=None):
         cfg = self.cfg
         x = _constrain_act(x, cfg)
         residual = x
         y = _layer_norm(cfg, "norm1")(x)
         y = SelfAttention(cfg, name="attn")(
             y, attn_mask, deterministic=deterministic, decode=decode,
-            cache_positions=cache_positions,
+            cache_positions=cache_positions, block_tables=block_tables,
         )
         y = _dropout(cfg, "attn_dropout")(y, deterministic=deterministic)
         x = residual + y
@@ -463,9 +582,10 @@ class _ScanLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, attn_mask, deterministic, decode,
-                 cache_positions=None):
+                 cache_positions=None, block_tables=None):
         x = DecoderLayer(self.cfg, name="layer")(
-            x, attn_mask, deterministic, decode, cache_positions
+            x, attn_mask, deterministic, decode, cache_positions,
+            block_tables
         )
         return x, None
 
@@ -509,7 +629,8 @@ class GPTModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, position_ids=None, attn_mask=None, *,
-                 deterministic=True, decode=False, cache_positions=None):
+                 deterministic=True, decode=False, cache_positions=None,
+                 block_tables=None):
         cfg = self.cfg
         word_emb = self.param(
             "word_embeddings",
@@ -537,12 +658,13 @@ class GPTModel(nn.Module):
         x = _dropout(cfg, "embed_dropout")(x, deterministic=deterministic)
 
         x = self._decoder_stack(x, attn_mask, deterministic=deterministic,
-                                decode=decode, cache_positions=cache_positions)
+                                decode=decode, cache_positions=cache_positions,
+                                block_tables=block_tables)
         x = _layer_norm(cfg, "final_norm")(x)
         return _constrain_act(x, cfg)
 
     def _decoder_stack(self, x, attn_mask, *, deterministic, decode,
-                       cache_positions=None):
+                       cache_positions=None, block_tables=None):
         cfg = self.cfg
         policy = _remat_policy(cfg)
         selective = cfg.no_recompute_layers
@@ -576,12 +698,13 @@ class GPTModel(nn.Module):
                 variable_axes={"params": 0, "cache": 0, "intermediates": 0},
                 split_rngs={"params": True, "dropout": True},
                 in_axes=(nn.broadcast, nn.broadcast, nn.broadcast,
-                         nn.broadcast),
+                         nn.broadcast, nn.broadcast),
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )
             x, _ = stack(cfg, name="layers")(x, attn_mask, deterministic,
-                                             decode, cache_positions)
+                                             decode, cache_positions,
+                                             block_tables)
             return x
         # Unrolled path: needed for per-layer recompute opt-out
         # (no_recompute_layers, reference single_model.py:473-475).
@@ -593,7 +716,8 @@ class GPTModel(nn.Module):
                     DecoderLayer, policy=policy, prevent_cse=False, static_argnums=(3, 4)
                 )
             x = layer_cls(cfg, name=f"layer_{i}")(
-                x, attn_mask, deterministic, decode, cache_positions
+                x, attn_mask, deterministic, decode, cache_positions,
+                block_tables
             )
         return x
 
@@ -609,7 +733,7 @@ class GPTForPretraining(nn.Module):
     @nn.compact
     def __call__(self, input_ids, position_ids=None, attn_mask=None, *,
                  deterministic=True, decode=False, cache_positions=None,
-                 labels=None):
+                 block_tables=None, labels=None):
         backbone = GPTModel(self.cfg, name="gpt")
         x = backbone(
             input_ids,
@@ -618,6 +742,7 @@ class GPTForPretraining(nn.Module):
             deterministic=deterministic,
             decode=decode,
             cache_positions=cache_positions,
+            block_tables=block_tables,
         )
         word_emb = backbone.variables["params"]["word_embeddings"]
         emb = word_emb.value if isinstance(word_emb, nn.Partitioned) else word_emb

@@ -30,6 +30,19 @@ What's different from the training kernel:
   tokens decoded so far — not ``cache_len``.
 - forward-only: decode never differentiates, so there is no VJP, no lse
   output, and no dropout plumbing.
+
+Paged variant (:func:`flash_decode_paged_attention`): the serving engine's
+page-granular cache stores K/V as ``[num_pages, page_size, h, d]`` shared
+pages and each batch row addresses its logical window through a block
+table of page indices (serving/cache_manager.py). The kernel body is THE
+SAME online-softmax walk with ``major == page_size`` — only the K/V index
+maps change: the per-row block table rides scalar prefetch next to
+``starts``/``ends``, and grid step ``jm`` (the row's logical page index)
+gathers physical page ``table[b, jm]`` instead of streaming block ``jm``
+of a contiguous buffer. Dead steps still clamp into the live
+``[first, last]`` logical range, so they repeat a resident physical page
+and trigger no DMA; pages shared between rows (prefix reuse) are simply
+gathered by several rows' tables.
 """
 
 from __future__ import annotations
@@ -51,7 +64,13 @@ from fleetx_tpu.ops.pallas.flash_attention import (
     _mm_dtype,
 )
 
-__all__ = ["flash_decode_attention", "decode_flash_supported", "fit_decode_blocks"]
+__all__ = [
+    "flash_decode_attention",
+    "flash_decode_paged_attention",
+    "decode_flash_supported",
+    "fit_decode_blocks",
+    "paged_gather_kv",
+]
 
 # Cache-dim tile sizes, swept independently of the training kernel's
 # (decode tiles trade MXU shape for DMA granularity — the query side is one
@@ -255,3 +274,128 @@ def flash_decode_attention(
         ),
         interpret=_interpret(),
     )(starts_b, ends_b, q, k, v)
+
+
+# ------------------------------------------------------------- paged variant
+
+
+def _paged_decode_kernel(starts_ref, ends_ref, tables_ref, q_ref, k_ref,
+                         v_ref, o_ref, m_scr, l_scr, acc_scr, *, block_k: int,
+                         page_size: int, scale: float):
+    """Grid step (bi, hi, jm) where ``jm`` is row bi's LOGICAL page index:
+    the block-table gather happens entirely in the K/V index maps, so the
+    online-softmax body is the contiguous kernel's with major=page_size
+    (``k_row`` below is the logical position jm*page_size + offset, which
+    the index map made physically resident)."""
+    del tables_ref  # consumed by the index maps, not the body
+    _decode_kernel(starts_ref, ends_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, block_k=block_k, major=page_size,
+                   scale=scale)
+
+
+def _paged_kv_index_map(page_size: int):
+    """Physical-page index for grid step (bi, hi, jm): the row's block
+    table translates the LOGICAL page index jm into a physical page of the
+    ``[num_pages, page_size, h, d]`` pool; jm is first clamped into the
+    row's live logical range so dead steps re-address a resident page (no
+    DMA), exactly like the contiguous kernel's clamp."""
+
+    def index_map(bi, hi, jm, starts_ref, ends_ref, tables_ref):
+        first = starts_ref[bi] // page_size
+        last = (ends_ref[bi] - 1) // page_size
+        return tables_ref[bi, jnp.clip(jm, first, last)], 0, hi, 0
+
+    return index_map
+
+
+def _paged_q_index_map(bi, hi, jm, starts_ref, ends_ref, tables_ref):
+    return bi, 0, hi, 0
+
+
+def paged_gather_kv(pages: jax.Array, tables: jax.Array) -> jax.Array:
+    """Dense-fallback gather: materialize each row's logical K/V buffer
+    ``[b, logical_len, h, d]`` from the shared page pool
+    ``[num_pages, page_size, h, d]`` via its block table ``[b, n_pages]``.
+
+    The XLA parity path off-TPU (and for multi-token prefill, custom
+    masks, meshes): it streams one logical cache's worth of HBM per call —
+    the same traffic the contiguous dense fallback pays — so correctness
+    fallbacks cost what they always cost, while the paged flash kernel
+    above never materializes this buffer."""
+    b, n_pages = tables.shape
+    gathered = pages[tables]  # [b, n_pages, page_size, h, d]
+    return gathered.reshape(b, n_pages * pages.shape[1], *pages.shape[2:])
+
+
+def flash_decode_paged_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    *,
+    tables: jax.Array,
+    end: jax.Array,
+    starts: Optional[jax.Array] = None,
+    block_k: Optional[int] = None,
+) -> jax.Array:
+    """Single-query attention against a PAGED kv cache.
+
+    ``k_pages``/``v_pages`` are the shared page pools
+    ``[num_pages, page_size, h, d]``; ``tables`` ([b, n_pages_per_row]
+    int32) maps each row's logical page index to its physical page, and
+    ``end`` ([b] or scalar int32, traced) is the row's live logical length
+    (its window is ``[starts[b], end[b])`` in LOGICAL positions). Rows
+    sharing prefix pages simply carry the same physical indices in their
+    tables — the kernel reads shared pages like any other.
+
+    ``page_size`` must be a multiple of 8 (callers pre-screen with
+    :func:`decode_flash_supported` on the page size); ``block_k`` tiles
+    within a page (largest divisor wins, as in the contiguous kernel).
+    """
+    b, sq, h, d = q.shape
+    if sq != 1:
+        raise ValueError(f"flash decode is single-query (q_len={sq})")
+    page_size = k_pages.shape[1]
+    # major is pinned to one page (the gather unit); block_k tiles inside
+    block_k, major = fit_decode_blocks(page_size, block_k, page_size)
+    if block_k is None or major != page_size:
+        raise ValueError(
+            f"page_size {page_size} not tileable (must be a multiple of 8)"
+        )
+    n_logical = tables.shape[1]
+
+    ends_b = jnp.broadcast_to(jnp.asarray(end, jnp.int32), (b,))
+    starts_b = (jnp.zeros((b,), jnp.int32) if starts is None
+                else starts.astype(jnp.int32))
+    tables_b = tables.astype(jnp.int32)
+
+    kernel = functools.partial(
+        _paged_decode_kernel, block_k=block_k, page_size=page_size,
+        scale=1.0 / (d**0.5)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, h, n_logical),
+        in_specs=[
+            pl.BlockSpec((None, 1, None, d), _paged_q_index_map),
+            pl.BlockSpec((None, page_size, None, d),
+                         _paged_kv_index_map(page_size)),
+            pl.BlockSpec((None, page_size, None, d),
+                         _paged_kv_index_map(page_size)),
+        ],
+        out_specs=pl.BlockSpec((None, 1, None, d), _paged_q_index_map),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),   # running max m
+            pltpu.VMEM((1, 1), jnp.float32),   # running normalizer l
+            pltpu.VMEM((1, d), jnp.float32),   # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, 1, h, d), q.dtype),
+        compiler_params=CompilerParams(
+            # the logical-page axis carries the online-softmax scratch state
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=_interpret(),
+    )(starts_b, ends_b, tables_b, q, k_pages, v_pages)

@@ -2,22 +2,34 @@
 
 The runtime layer the reference toolkit never had: instead of one padded
 batch per blocking ``generate()`` call, a slot-based scheduler keeps the
-decode batch full — requests are admitted into free kv-cache slots the
+decode batch full — requests are admitted into free decode lanes the
 tick they arrive (prefill-on-insert), every tick runs ONE jitted decode
-step over all slots at their own depths, and finished requests free
-their slot immediately for the next queued request.
+step over all lanes at their own depths, and finished requests free
+their lane immediately for the next queued request.
+
+K/V storage is PAGED by default: a shared ``[num_pages, page_size, ...]``
+pool with per-request block tables and a refcounted prefix trie, so
+cache capacity tracks live tokens (page-granular admission) and requests
+sharing a system prompt reuse one prefill (``FLEETX_SERVING_PAGED=0``
+restores the fixed per-slot cache).
 
     engine = ServingEngine(model, variables, slots=8)
     rid = engine.submit(prompt_ids, max_length=64)
     results = engine.drain()          # {rid: ServingResult}
 
-Layout: ``cache_manager`` (slot cache + live-window safety argument),
-``scheduler`` (FIFO admission policy seam), ``engine`` (submit/step/drain
-loop + jitted prefill/decode), ``metrics`` (queue/TTFT/throughput
+Layout: ``cache_manager`` (page pool + prefix trie + slot-compat cache,
+and the no-zeroing live-window safety argument), ``scheduler`` (FIFO
+admission policy seam), ``engine`` (submit/step/drain loop + jitted
+prefill/decode), ``metrics`` (queue/TTFT/throughput/prefix-reuse
 observability). docs/SERVING.md has the architecture tour.
 """
 
-from fleetx_tpu.serving.cache_manager import SlotKVCacheManager, scatter_slot
+from fleetx_tpu.serving.cache_manager import (
+    PagedKVCacheManager,
+    PagePool,
+    SlotKVCacheManager,
+    scatter_slot,
+)
 from fleetx_tpu.serving.engine import (
     QueueFull,
     ServingEngine,
@@ -31,6 +43,8 @@ __all__ = [
     "QueueFull",
     "ServingEngine",
     "ServingResult",
+    "PagePool",
+    "PagedKVCacheManager",
     "SlotKVCacheManager",
     "FIFOScheduler",
     "Request",

@@ -1,33 +1,56 @@
-"""Slot-based kv-cache manager for continuous-batching decode.
+"""KV-cache managers for continuous-batching decode: paged + slot-based.
 
-Owns ONE fixed ``[slots, cache_len]`` decode cache (the flax 'cache'
-collection tree built by ``generation.init_decode_cache``) and maps
-requests onto free slots. The flash-decode live-window contract
-(ops/pallas/decode_attention.py) is what makes slot reuse safe without
-ever zeroing the buffers:
+Two storage strategies behind one engine:
 
-- each slot's attention window is ``[0, lengths[slot] + 1)`` — the
-  per-row ``end`` the serving decode step derives from its write
-  positions — so K/V rows a *previous* tenant left beyond the current
-  length are never attended;
-- a fresh tenant's prefill overwrites ``[0, prompt_len)`` and every
-  decode tick overwrites position ``lengths[slot]`` *before* the window
+- :class:`PagedKVCacheManager` (the default): K/V live in ONE shared pool
+  of ``[num_pages, page_size, heads, head_dim]`` pages; each request
+  holds a block table mapping its logical positions to physical pages
+  (vLLM-style). Cache capacity and prefill compute track tokens actually
+  live, not per-slot worst case: a short request pins pages for ITS
+  tokens only, and requests sharing a token prefix share the prefix's
+  pages through a refcounted trie (:class:`PagePool`) — one prefill
+  serves them all.
+- :class:`SlotKVCacheManager` (compat, ``paged=False`` /
+  ``FLEETX_SERVING_PAGED=0``): the original fixed ``[slots, cache_len]``
+  cache, one full-length lane per request.
+
+Both rely on the flash-decode live-window contract
+(ops/pallas/decode_attention.py) to skip ALL buffer zeroing:
+
+- each row's attention window is ``[0, lengths[row] + 1)`` — the per-row
+  ``end`` the serving decode step derives from its write positions — so
+  K/V a *previous* tenant left beyond the current length (or in a
+  recycled page) is never attended;
+- a fresh tenant's prefill overwrites its window's positions and every
+  decode tick overwrites position ``lengths[row]`` *before* the window
   grows to include it, so stale rows are always replaced before they
   become visible.
 
-The scalar ``cache_index`` leaves inside the tree are unused on this
-path (per-slot progress lives in ``lengths``; the model receives explicit
-``cache_positions`` instead) — see ``SelfAttention._update_cache``.
+The paged pool reserves physical page 0 as the TRASH page: zeroed block-
+table entries (freed lanes, logical pages not yet allocated) route the
+engine's pinned/tail writes there, so no write can land in a page owned
+by someone else. Copy-on-write degenerates to an invariant instead of a
+copy: only FULL prompt pages are ever shared (registered in the trie),
+writes only target positions >= the shared prefix length, and those
+positions live in freshly-allocated refcount-1 pages — a shared page is
+structurally read-only.
+
+The scalar ``cache_index`` leaves inside the cache tree are unused on
+the serving path (per-row progress lives in ``lengths``; the model
+receives explicit ``cache_positions`` instead) — see
+``SelfAttention._update_cache``.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+import heapq
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
-__all__ = ["SlotKVCacheManager", "scatter_slot"]
+__all__ = ["PagePool", "PagedKVCacheManager", "SlotKVCacheManager",
+           "scatter_slot"]
 
 
 def scatter_slot(cache, prefill_cache, slot):
@@ -49,46 +72,67 @@ def scatter_slot(cache, prefill_cache, slot):
     return jax.tree.map(put, cache, prefill_cache)
 
 
-class SlotKVCacheManager:
+class _LaneBook:
+    """Decode-lane bookkeeping shared by both cache managers: a min-heap
+    free list (lowest lane first, deterministic, O(log n) alloc/free —
+    the original list re-sorted on every release), per-lane request ids,
+    and the HOST mirror of per-lane live lengths (the device copy rides
+    the engine's state dict) — kept for observability without a device
+    sync."""
+
+    def _init_lanes(self, slots: int) -> None:
+        if slots < 1:
+            raise ValueError(f"need at least one slot, got {slots}")
+        self.slots = slots
+        self.lengths = np.zeros(slots, np.int64)
+        self.request_ids: List[Optional[int]] = [None] * slots
+        self._free: List[int] = list(range(slots))
+
+    @property
+    def free_count(self) -> int:
+        """Number of decode lanes available for admission."""
+        return len(self._free)
+
+    @property
+    def active_count(self) -> int:
+        """Number of decode lanes currently holding a live request."""
+        return self.slots - len(self._free)
+
+    def occupancy(self) -> float:
+        """Fraction of decode lanes holding a live request."""
+        return self.active_count / self.slots
+
+    def _claim_lane(self, request_id: int, length: int) -> int:
+        lane = heapq.heappop(self._free)
+        self.request_ids[lane] = request_id
+        self.lengths[lane] = length
+        return lane
+
+    def _release_lane(self, slot: int) -> None:
+        if self.request_ids[slot] is None:
+            raise ValueError(f"slot {slot} is already free")
+        self.request_ids[slot] = None
+        self.lengths[slot] = 0
+        heapq.heappush(self._free, slot)
+
+
+class SlotKVCacheManager(_LaneBook):
     """Fixed-slot decode cache + slot bookkeeping (free list, tenants).
 
     ``cache`` is the live device tree; the engine routes it through its
-    jitted prefill/decode functions and stores the result back here.
-    ``lengths`` is the HOST mirror of per-slot live row counts (the device
-    copy rides the engine's state dict) — kept for observability without a
-    device sync."""
+    jitted prefill/decode functions and stores the result back here."""
 
     def __init__(self, model, slots: int, cache_len: int):
         from fleetx_tpu.models.gpt.generation import init_decode_cache
 
-        if slots < 1:
-            raise ValueError(f"need at least one slot, got {slots}")
         if (model.cfg.decode_cache_len or 0) != cache_len:
             raise ValueError(
                 f"model.cfg.decode_cache_len ({model.cfg.decode_cache_len}) "
                 f"must equal the manager's cache_len ({cache_len})"
             )
-        self.slots = slots
+        self._init_lanes(slots)
         self.cache_len = cache_len
         self.cache = init_decode_cache(model, slots)
-        self.lengths = np.zeros(slots, np.int64)
-        self.request_ids: List[Optional[int]] = [None] * slots
-        # lowest-index-first allocation keeps runs deterministic
-        self._free = list(range(slots - 1, -1, -1))
-
-    @property
-    def free_count(self) -> int:
-        """Number of slots available for admission."""
-        return len(self._free)
-
-    @property
-    def active_count(self) -> int:
-        """Number of slots currently holding a live request."""
-        return self.slots - len(self._free)
-
-    def occupancy(self) -> float:
-        """Fraction of slots holding a live request."""
-        return self.active_count / self.slots
 
     def alloc(self, request_id: int, prompt_len: int) -> Optional[int]:
         """Claim the lowest free slot for ``request_id`` (None when full)."""
@@ -98,18 +142,391 @@ class SlotKVCacheManager:
             raise ValueError(
                 f"prompt_len {prompt_len} exceeds cache_len {self.cache_len}"
             )
-        slot = self._free.pop()
-        self.request_ids[slot] = request_id
-        self.lengths[slot] = prompt_len
-        return slot
+        return self._claim_lane(request_id, prompt_len)
 
     def free(self, slot: int) -> None:
         """Release ``slot`` for the next queued request. No buffer zeroing:
         the live-window contract (module docstring) keeps stale rows
         invisible to the next tenant."""
+        self._release_lane(slot)
+
+
+class _TrieNode:
+    """One full page of prompt tokens in the prefix trie: ``key`` is the
+    page's token tuple, ``page`` its physical index; children extend the
+    prefix by one more full page. The node path from the root IS the
+    prefix hash — dict lookups chunk by chunk, no rolling hash to
+    collide."""
+
+    __slots__ = ("key", "page", "parent", "children")
+
+    def __init__(self, key, page: int, parent: "_TrieNode" = None):
+        self.key = key
+        self.page = page
+        self.parent = parent
+        self.children: Dict[tuple, "_TrieNode"] = {}
+
+
+class PagePool:
+    """Host-side page allocator + refcounted prefix trie (PURE host state
+    — no device arrays, so allocator/trie invariants are unit-testable
+    without a model or backend).
+
+    Physical page 0 is the reserved TRASH page (module docstring): it is
+    born with a permanent refcount, never enters the free stack, and
+    absorbs every write routed through a zeroed block-table entry.
+
+    Lifecycle of a shareable page: a full prompt page is prefilled into a
+    refcount-1 page, registered in the trie (``register_prefix``), and
+    from then on other lanes' ``alloc`` calls can match it (refcount++).
+    When its last holder frees, the page parks in ``_cached`` — content
+    intact, trie node alive — where a later match revives it for free or
+    LRU eviction reclaims it (evicting a node evicts its whole subtree:
+    children's refcounts can never exceed their parent's, so a refcount-0
+    parent guarantees refcount-0 children and nothing live is stranded).
+
+    Alloc/free cost: O(pages touched) with an O(1) free-stack — no sort,
+    no scan of the pool."""
+
+    def __init__(self, num_pages: int, page_size: int, lanes: int,
+                 lane_pages: int, prefix_cache: bool = True):
+        if page_size < 1:
+            raise ValueError(f"page_size must be positive, got {page_size}")
+        if num_pages < lane_pages + 1:
+            raise ValueError(
+                f"num_pages {num_pages} cannot hold one full lane "
+                f"({lane_pages} pages) plus the trash page")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.lanes = lanes
+        self.lane_pages = lane_pages
+        self.prefix_cache = prefix_cache
+        # block tables: 0 = trash page = "not allocated"
+        self.tables = np.zeros((lanes, lane_pages), np.int32)
+        self.alloc_counts = np.zeros(lanes, np.int64)
+        self.shared_counts = np.zeros(lanes, np.int64)
+        self.ref = np.zeros(num_pages, np.int64)
+        self.ref[0] = 1  # trash page: permanently pinned
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._root = _TrieNode(None, 0, None)
+        self._node_of_page: Dict[int, _TrieNode] = {}
+        # refcount-0 pages still registered in the trie, insertion order =
+        # LRU (dicts preserve it; moves re-insert)
+        self._cached: Dict[int, _TrieNode] = {}
+        # bumped on every block-table change so the engine re-uploads the
+        # device copy only when something moved
+        self.version = 0
+
+    # ------------------------------------------------------------- stats
+
+    @property
+    def usable_pages(self) -> int:
+        """Pages available to requests (the pool minus the trash page)."""
+        return self.num_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        """Pages obtainable right now: the free stack plus refcount-0
+        cached pages (reclaimable by LRU eviction)."""
+        return len(self._free) + len(self._cached)
+
+    @property
+    def pages_in_use(self) -> int:
+        """Pages pinned by at least one live lane."""
+        return self.usable_pages - self.free_pages
+
+    @property
+    def cached_pages(self) -> int:
+        """Refcount-0 pages kept warm in the trie (reclaimable)."""
+        return len(self._cached)
+
+    def occupancy(self) -> float:
+        """Fraction of usable pages pinned by live lanes."""
+        return self.pages_in_use / max(self.usable_pages, 1)
+
+    # ------------------------------------------------------------ helpers
+
+    def _chunks(self, tokens) -> List[tuple]:
+        """Full-page token tuples of a prompt, capped so at least the last
+        prompt token is always re-prefilled (its logits seed the first
+        sampled token — a 100% trie hit would leave nothing to run)."""
+        n = (len(tokens) - 1) // self.page_size
+        return [tuple(int(t) for t in
+                      tokens[i * self.page_size:(i + 1) * self.page_size])
+                for i in range(n)]
+
+    def _match_path(self, chunks) -> List[_TrieNode]:
+        path, node = [], self._root
+        for c in chunks:
+            node = node.children.get(c)
+            if node is None:
+                break
+            path.append(node)
+        return path
+
+    def _take_page(self) -> Optional[int]:
+        """Pop a free page; when the stack is dry, evict the LRU cached
+        prefix subtree (all refcount-0 by the parent>=child invariant)."""
+        if not self._free:
+            if not self._cached:
+                return None
+            node = next(iter(self._cached.values()))  # oldest zero-ref
+            self._evict_subtree(node)
+        return self._free.pop()
+
+    def _evict_subtree(self, node: _TrieNode) -> None:
+        if node.parent is not None:
+            del node.parent.children[node.key]
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            self._cached.pop(n.page, None)
+            del self._node_of_page[n.page]
+            self._free.append(n.page)
+            n.children = {}
+            n.parent = None
+
+    # ----------------------------------------------------------- requests
+
+    def pages_needed(self, tokens) -> int:
+        """Pages an ``alloc`` of this prompt would draw from the
+        free/reclaimable pool: fresh pages covering the non-shared part
+        of ``[0, prompt_len]`` (the +1 slot is the first sampled token's
+        write position), PLUS matched prefix pages currently parked in
+        the warm cache — revival moves those out of the reclaimable
+        count, so they cost pool capacity exactly like a fresh claim."""
+        chunks = self._chunks(tokens) if self.prefix_cache else []
+        path = self._match_path(chunks)
+        fresh = len(tokens) // self.page_size + 1 - len(path)
+        revived = sum(1 for n in path if self.ref[n.page] == 0)
+        return fresh + revived
+
+    def can_admit(self, tokens) -> bool:
+        """Page-granular admission check: True iff ``alloc`` would
+        succeed right now (exact — kept in lockstep with ``alloc``'s own
+        availability accounting, so the engine can pop-then-alloc)."""
+        return self.pages_needed(tokens) <= self.free_pages
+
+    def alloc(self, lane: int, tokens) -> Optional[int]:
+        """Build ``lane``'s block table for prompt ``tokens``: shared
+        prefix pages from the trie (refcount++) plus fresh refcount-1
+        pages covering the rest of ``[0, prompt_len]``. Returns the shared
+        prefix length in TOKENS (0 = no reuse), or None — with no state
+        committed — when the pool cannot supply the fresh pages."""
+        if self.alloc_counts[lane]:
+            raise ValueError(f"lane {lane} already holds pages")
+        need_total = len(tokens) // self.page_size + 1
+        if need_total > self.lane_pages:
+            # checked BEFORE any ref is committed: an over-long prompt
+            # must raise cleanly, not corrupt the pool mid-claim
+            raise ValueError(
+                f"prompt of {len(tokens)} tokens needs {need_total} logical "
+                f"pages; a lane holds {self.lane_pages}")
+        chunks = self._chunks(tokens) if self.prefix_cache else []
+        path = self._match_path(chunks)
+        # commit the matched refs FIRST: revived pages leave _cached, so
+        # the availability check below sees the true reclaimable count and
+        # eviction can no longer touch the matched path (ref > 0)
+        for n in path:
+            if self.ref[n.page] == 0:
+                del self._cached[n.page]
+            self.ref[n.page] += 1
+        fresh = need_total - len(path)
+        if fresh > self.free_pages:
+            for n in reversed(path):  # unwind: nothing committed
+                self.ref[n.page] -= 1
+                if self.ref[n.page] == 0:
+                    self._cached[n.page] = n
+            return None
+        row = self.tables[lane]
+        row[:] = 0
+        for i, n in enumerate(path):
+            row[i] = n.page
+        for i in range(len(path), need_total):
+            page = self._take_page()
+            self.ref[page] = 1
+            row[i] = page
+        self.alloc_counts[lane] = need_total
+        self.shared_counts[lane] = len(path)
+        self.version += 1
+        return len(path) * self.page_size
+
+    def register_prefix(self, lane: int, tokens) -> None:
+        """Insert ``lane``'s freshly-prefilled FULL prompt pages into the
+        trie so later prompts can share them. Idempotent over the already-
+        matched prefix; only refcount-1 pages this lane exclusively owns
+        are ever registered (the copy-on-write invariant: pages become
+        shareable exactly when they will never be written again)."""
+        if not self.prefix_cache:
+            return
+        node = self._root
+        row = self.tables[lane]
+        for i, c in enumerate(self._chunks(tokens)):
+            nxt = node.children.get(c)
+            if nxt is None:
+                nxt = _TrieNode(c, int(row[i]), node)
+                node.children[c] = nxt
+                self._node_of_page[nxt.page] = nxt
+            node = nxt
+
+    def ensure_page(self, lane: int, pos: int) -> bool:
+        """Make logical position ``pos`` writable for ``lane`` (grow-on-
+        demand: the engine calls this before each decode tick's write).
+        False = the pool is dry (caller retires the request), or ``pos``
+        is past the lane's logical capacity."""
+        li = pos // self.page_size
+        if li < self.alloc_counts[lane]:
+            return True
+        if li >= self.lane_pages:
+            return False
+        page = self._take_page()
+        if page is None:
+            return False
+        self.ref[page] = 1
+        self.tables[lane, li] = page
+        self.alloc_counts[lane] = li + 1
+        self.version += 1
+        return True
+
+    def free(self, lane: int) -> None:
+        """Release every page of ``lane``'s chain (refcount--). Zero-ref
+        pages return to the free stack — unless they are trie-registered,
+        in which case they park in the LRU cache with content intact so
+        the next matching prompt revives them for free."""
+        if not self.alloc_counts[lane]:
+            raise ValueError(f"lane {lane} holds no pages (double-freed?)")
+        row = self.tables[lane]
+        for i in range(int(self.alloc_counts[lane])):
+            page = int(row[i])
+            if self.ref[page] <= 0:
+                raise ValueError(
+                    f"page {page} of lane {lane} double-freed")
+            self.ref[page] -= 1
+            if self.ref[page] == 0:
+                node = self._node_of_page.get(page)
+                if node is not None:
+                    self._cached[page] = node
+                else:
+                    self._free.append(page)
+        row[:] = 0
+        self.alloc_counts[lane] = 0
+        self.shared_counts[lane] = 0
+        self.version += 1
+
+
+class PagedKVCacheManager(_LaneBook):
+    """Page-granular decode cache + lane bookkeeping (the paged sibling of
+    :class:`SlotKVCacheManager`; module docstring has the design).
+
+    Decode *lanes* (batch rows of the jitted step) are still allocated
+    lowest-free-first like slots — ``free_count``/``active_count`` keep
+    their slot-era meaning — but storage admission is by PAGES: a lane is
+    only claimable when :class:`PagePool` can cover the prompt, and the
+    chain grows page-by-page as the request decodes. ``cache`` is the live
+    device tree of ``[num_pages, page_size, heads, head_dim]`` leaves;
+    ``tables`` the host block tables the engine uploads when ``version``
+    moves."""
+
+    def __init__(self, model, slots: int, cache_len: int, num_pages: int,
+                 page_size: int, prefix_cache: bool = True):
+        from fleetx_tpu.models.gpt.generation import init_decode_cache
+
+        if page_size % 8:
+            raise ValueError(
+                f"page_size must be a multiple of 8 (flash-decode tiling "
+                f"contract), got {page_size}")
+        if cache_len % page_size:
+            raise ValueError(
+                f"cache_len {cache_len} must be a multiple of page_size "
+                f"{page_size}")
+        cfg = model.cfg
+        if (cfg.decode_cache_len, cfg.decode_num_pages,
+                cfg.decode_page_size) != (cache_len, num_pages, page_size):
+            raise ValueError(
+                "model cfg (decode_cache_len, decode_num_pages, "
+                f"decode_page_size) = ({cfg.decode_cache_len}, "
+                f"{cfg.decode_num_pages}, {cfg.decode_page_size}) must "
+                f"match the manager's ({cache_len}, {num_pages}, "
+                f"{page_size})")
+        self._init_lanes(slots)
+        self.cache_len = cache_len
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.pool = PagePool(num_pages, page_size, slots,
+                             cache_len // page_size, prefix_cache)
+        self.cache = init_decode_cache(model, slots)
+
+    # ------------------------------------------------------- page surface
+
+    @property
+    def tables(self) -> np.ndarray:
+        """Host block tables [slots, cache_len // page_size] int32."""
+        return self.pool.tables
+
+    @property
+    def tables_version(self) -> int:
+        """Monotone counter: re-upload the device tables when it moves."""
+        return self.pool.version
+
+    @property
+    def pages_in_use(self) -> int:
+        """Pages pinned by live requests (trash page excluded)."""
+        return self.pool.pages_in_use
+
+    @property
+    def usable_pages(self) -> int:
+        """Pages the pool can hand to requests."""
+        return self.pool.usable_pages
+
+    def page_occupancy(self) -> float:
+        """Fraction of usable pages pinned by live requests."""
+        return self.pool.occupancy()
+
+    # ---------------------------------------------------------- lifecycle
+
+    def can_admit(self, tokens) -> bool:
+        """A free lane AND enough free pages for this prompt right now."""
+        return bool(self._free) and self.pool.can_admit(tokens)
+
+    def alloc(self, request_id: int, tokens) -> Optional[Tuple[int, int]]:
+        """Claim the lowest free lane + a page chain for prompt ``tokens``.
+        Returns ``(lane, shared_len)`` — ``shared_len`` tokens of trie-
+        shared prefix whose prefill is skipped — or None (nothing claimed)
+        when lanes or pages are short."""
+        if not self._free:
+            return None
+        if len(tokens) >= self.cache_len:
+            # >= not >: a full-capacity prompt would need lane_pages + 1
+            # logical pages (the first sampled token's slot) — and has no
+            # decode room anyway, mirroring the engine's submit() guard
+            raise ValueError(
+                f"prompt_len {len(tokens)} leaves no decode room "
+                f"(cache_len {self.cache_len})")
+        lane = self._free[0]  # peek: only claim once pages are certain
+        shared = self.pool.alloc(lane, tokens)
+        if shared is None:
+            return None
+        claimed = self._claim_lane(request_id, len(tokens))
+        assert claimed == lane  # heap head == the lane the pool filled
+        return lane, shared
+
+    def register_prefix(self, slot: int, tokens) -> None:
+        """Publish ``slot``'s freshly-prefilled full prompt pages for
+        sharing (see :meth:`PagePool.register_prefix`)."""
+        self.pool.register_prefix(slot, tokens)
+
+    def ensure_page(self, slot: int) -> bool:
+        """Grow ``slot``'s chain to cover its next write position
+        (``lengths[slot]``); False = pool dry, caller retires the
+        request."""
+        return self.pool.ensure_page(slot, int(self.lengths[slot]))
+
+    def free(self, slot: int) -> None:
+        """Release the lane and its page chain. No buffer zeroing — the
+        live-window contract (module docstring) plus zeroed table entries
+        (all writes re-route to the trash page) keep stale K/V dark."""
         if self.request_ids[slot] is None:
             raise ValueError(f"slot {slot} is already free")
-        self.request_ids[slot] = None
-        self.lengths[slot] = 0
-        self._free.append(slot)
-        self._free.sort(reverse=True)
+        self.pool.free(slot)
+        self._release_lane(slot)

@@ -6,13 +6,24 @@ decode functions exposed by ``models/gpt/generation.py``:
 - **submit()** queues a request (FIFO) with per-request overrides for
   max/min length, EOS, sampling knobs, and an independent RNG stream.
 - **step()** is one scheduler tick: admit queued requests into free slots
-  (prefill-on-insert — each prompt is prefilled batch-1 into a fresh
-  cache and scattered into its slot, its first token sampled in the same
-  jitted call), then ONE jitted decode step over ALL slots, then per-slot
-  EOS / max-length retirement that frees slots for the next tick's
-  admissions.
+  (prefill-on-insert — each prompt is prefilled batch-1 into its slot's
+  storage, its first token sampled in the same jitted call), then ONE
+  jitted decode step over ALL slots, then per-slot EOS / max-length
+  retirement that frees slots for the next tick's admissions.
 - **drain()** ticks until queue and slots are empty and returns the
   finished :class:`ServingResult` records.
+
+Cache storage is PAGED by default (``FLEETX_SERVING_PAGED=0`` or
+``paged=False`` restores the fixed per-slot cache): K/V live in a shared
+``[num_pages, page_size, heads, head_dim]`` pool, each request holds a
+block table of page indices, and a refcounted prefix trie lets requests
+sharing a token prefix (system prompts) reuse one prefill — admission is
+then page-granular (the queue head admits when its PAGES fit, not when a
+worst-case slot does), prefill runs only over the non-shared prompt
+suffix, and a request's chain grows page-by-page as it decodes
+(``finish_reason="cache_full"`` when the pool runs dry mid-flight). See
+``cache_manager.py`` for the allocator/trie and the no-zeroing safety
+argument; both storage modes emit byte-identical greedy tokens.
 
 Per-slot progress is carried as explicit ``cache_positions`` into the
 model (``SelfAttention._update_cache``), so slots decode at different
@@ -58,7 +69,11 @@ from fleetx_tpu.models.gpt.generation import (
     decode_step,
     init_decode_cache,
 )
-from fleetx_tpu.serving.cache_manager import SlotKVCacheManager, scatter_slot
+from fleetx_tpu.serving.cache_manager import (
+    PagedKVCacheManager,
+    SlotKVCacheManager,
+    scatter_slot,
+)
 from fleetx_tpu.serving.metrics import ServingMetrics
 from fleetx_tpu.serving.scheduler import FIFOScheduler, Request
 from fleetx_tpu.utils.log import logger
@@ -153,7 +168,11 @@ class ServingEngine:
                  metrics: Optional[ServingMetrics] = None,
                  max_queue: Optional[int] = None,
                  queue_ttl_s: Optional[float] = None,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 paged: Optional[bool] = None,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None):
         gen_cfg = gen_cfg or GenerationConfig(decode_strategy="greedy")
         if gen_cfg.repetition_penalty != 1.0:
             raise ValueError("continuous batching does not support "
@@ -163,16 +182,43 @@ class ServingEngine:
                              "forced_eos_token_id")
         self.gen_cfg = gen_cfg
         self.slots = slots or _env_int("FLEETX_SERVING_SLOTS", 8)
+        self.paged = (paged if paged is not None
+                      else _env_int("FLEETX_SERVING_PAGED", 1) == 1)
+        self.page_size = page_size or _env_int("FLEETX_SERVING_PAGE_SIZE", 16)
         cache_len = (cache_len
                      or _env_int("FLEETX_SERVING_CACHE_LEN", 0)
                      or model.cfg.max_position_embeddings)
-        if model.cfg.use_flash_attention:
+        if self.paged:
+            # per-request logical capacity rounds to whole pages (the page
+            # is also the flash-decode DMA tile, so this covers the 8-row
+            # rounding below)
+            cache_len += -cache_len % self.page_size
+        elif model.cfg.use_flash_attention:
             # round up to the flash-decode kernel's 8-row KV tile so the
             # fast path engages; the extra rows are never attended
             cache_len += -cache_len % 8
         self.cache_len = cache_len
-        self.model = model.clone(
-            cfg=dataclasses.replace(model.cfg, decode_cache_len=cache_len))
+        if self.paged:
+            # default pool = the slot cache's capacity in pages + the
+            # reserved trash page; short requests then leave pages free
+            # for extra concurrent tenants instead of padding dead slots
+            self.num_pages = (num_pages
+                              or _env_int("FLEETX_SERVING_PAGES", 0)
+                              or self.slots * (cache_len // self.page_size)
+                              + 1)
+            self.prefix_cache = (
+                prefix_cache if prefix_cache is not None
+                else _env_int("FLEETX_SERVING_PREFIX_CACHE", 1) == 1)
+            self.model = model.clone(cfg=dataclasses.replace(
+                model.cfg, decode_cache_len=cache_len,
+                decode_num_pages=self.num_pages,
+                decode_page_size=self.page_size))
+        else:
+            self.num_pages = 0
+            self.prefix_cache = False
+            self.model = model.clone(cfg=dataclasses.replace(
+                model.cfg, decode_cache_len=cache_len,
+                decode_num_pages=None, decode_page_size=None))
         self.params = (variables["params"]
                        if isinstance(variables, dict) and "params" in variables
                        else variables)
@@ -191,8 +237,15 @@ class ServingEngine:
         self.deadline_s = (deadline_s if deadline_s is not None
                            else _env_float("FLEETX_SERVING_DEADLINE_S", 0.0))
         self._now = time.perf_counter  # swappable clock (chaos tests)
-        self.cache_manager = SlotKVCacheManager(self.model, self.slots,
-                                                cache_len)
+        if self.paged:
+            self.cache_manager = PagedKVCacheManager(
+                self.model, self.slots, cache_len, self.num_pages,
+                self.page_size, prefix_cache=self.prefix_cache)
+        else:
+            self.cache_manager = SlotKVCacheManager(self.model, self.slots,
+                                                    cache_len)
+        self._tables_dev = None       # device mirror of the block tables,
+        self._tables_version = -1     # refreshed when the manager's moves
         self.scheduler = FIFOScheduler()
         self.metrics = metrics or ServingMetrics(self.slots)
         self._base_key = jax.random.PRNGKey(base_seed)
@@ -208,7 +261,7 @@ class ServingEngine:
         # for deterministic decode) skips the sampler entirely — at most
         # two cached compilations
         self._decode_jit = jax.jit(
-            self._decode_fn, static_argnums=(3,),
+            self._decode_fn, static_argnums=(4,),
             donate_argnums=(1, 2) if donate else ())
         self._admit_jit = jax.jit(self._admit_fn, donate_argnums=())
         self._deactivate_jit = jax.jit(_deactivate)
@@ -310,7 +363,7 @@ class ServingEngine:
         summary dict (``timed_out`` lists this tick's deadline victims)."""
         timed_out = self._expire_queued(self._now())
         admitted = 0
-        while self.cache_manager.free_count and len(self.scheduler):
+        while len(self.scheduler) and self._can_admit(self.scheduler.peek()):
             self._admit(self.scheduler.pop_next())
             admitted += 1
         decoded = len(self._active)
@@ -322,6 +375,9 @@ class ServingEngine:
         self._ticks += 1
         self.metrics.observe_tick(self.scheduler.queue_depth,
                                   len(self._active))
+        if self.paged:
+            self.metrics.observe_pages(self.cache_manager.pages_in_use,
+                                       self.cache_manager.usable_pages)
         if self.log_every and self._ticks % self.log_every == 0:
             self.metrics.log_snapshot()
         return {"admitted": admitted, "decoded": decoded,
@@ -483,6 +539,27 @@ class ServingEngine:
             "rng": st["rng"].at[slot].set(key),
         }
 
+    def _can_admit(self, req: Request) -> bool:
+        """FIFO-head admission judgment: a free decode lane, and — paged —
+        enough free pages for the head's prompt (page-granular admission:
+        total live tokens gate entry, not worst-case slot capacity). A
+        too-big head BLOCKS, preserving arrival order deterministically;
+        it unblocks as retiring requests return pages."""
+        if self.paged:
+            return self.cache_manager.can_admit(req.prompt)
+        return self.cache_manager.free_count > 0
+
+    def _device_tables(self):
+        """Device copy of the block tables, re-uploaded only when the
+        manager's version counter moved (None on the slot path)."""
+        if not self.paged:
+            return None
+        version = self.cache_manager.tables_version
+        if version != self._tables_version:
+            self._tables_dev = jnp.asarray(self.cache_manager.tables)
+            self._tables_version = version
+        return self._tables_dev
+
     def _make_prefill(self, bucket_len: int):
         """Jitted prefill-on-insert for prompts bucketed to ``bucket_len``:
         batch-1 cached forward into a fresh cache, scatter into the slot,
@@ -514,7 +591,44 @@ class ServingEngine:
         return jax.jit(
             prefill, donate_argnums=(1,) if self._donate_cache else ())
 
-    def _admit(self, req: Request) -> None:
+    def _make_paged_prefill(self, bucket_len: int):
+        """Jitted paged prefill-on-insert for prompt SUFFIXES bucketed to
+        ``bucket_len``: the non-shared tail of the prompt runs a batch-1
+        cached forward writing K/V straight into the lane's pages (no
+        fresh cache, no scatter), attending the trie-shared prefix pages
+        already in place, then samples the first token — the prefix-cache
+        compute saving is exactly the skipped ``wpos`` leading tokens."""
+        max_pos = self.model.cfg.max_position_embeddings
+
+        def prefill(params, cache, suffix, true_len, wpos, table, eos,
+                    min_new, greedy, temperature, top_k, top_p, key):
+            ids = suffix[None, :]
+            # absolute positions wpos.. for the suffix; the right-pad
+            # bucket tail is causally invisible and its writes land beyond
+            # the live window (or on the trash page) — cache_manager.py
+            pos = jnp.minimum(wpos + jnp.arange(bucket_len, dtype=jnp.int32),
+                              max_pos - 1)[None, :]
+            logits, cache = decode_step(
+                self.model, params, cache, ids, pos,
+                cache_positions=wpos[None], block_tables=table[None])
+            last = jax.lax.dynamic_slice_in_dim(
+                logits[0], true_len - 1, 1, axis=0).astype(jnp.float32)
+            vocab = last.shape[-1]
+            last = jnp.where(
+                (jnp.arange(vocab)[None, :] == eos) & (min_new > 0),
+                _NEG, last)
+            tok = sample_tokens(
+                last, key[None], greedy[None], temperature[None],
+                top_k[None], top_p[None], topk_cap=self.topk_cap)[0]
+            return cache, tok
+
+        return jax.jit(
+            prefill, donate_argnums=(1,) if self._donate_cache else ())
+
+    def _slot_prefill(self, req: Request):
+        """Slot-path admission storage: claim a slot, prefill the WHOLE
+        prompt batch-1 into a fresh cache and scatter it into the slot's
+        row. Returns ``(first_token, carry_key)``; sets ``req.slot``."""
         slot = self.cache_manager.alloc(req.id, req.prompt_len)
         req.slot = slot
         bucket = -(-req.prompt_len // self.prefill_bucket) * self.prefill_bucket
@@ -538,6 +652,56 @@ class ServingEngine:
             step_key,
         )
         self.cache_manager.cache = cache
+        return tok, carry_key
+
+    def _paged_prefill(self, req: Request):
+        """Paged-path admission storage: claim a lane + page chain (trie-
+        shared prefix pages skip their prefill entirely), run the batch-1
+        suffix prefill straight into the pages, publish the prompt's full
+        pages for sharing. Returns ``(first_token, carry_key)``; sets
+        ``req.slot``."""
+        alloc = self.cache_manager.alloc(req.id, req.prompt)
+        if alloc is None:  # _can_admit() passed, so this is an invariant
+            raise RuntimeError(  # breach — fail loudly, not via unpack
+                f"paged alloc failed after admission check for request "
+                f"{req.id} (prompt {req.prompt_len} tokens; "
+                f"{self.cache_manager.pool.free_pages} pages free)")
+        lane, shared = alloc
+        req.slot = lane
+        suffix = req.prompt[shared:]
+        bucket = -(-len(suffix) // self.prefill_bucket) * self.prefill_bucket
+        bucket = min(max(bucket, len(suffix)), self.cache_len - shared)
+        fn = self._prefill_jits.get(bucket)
+        if fn is None:
+            fn = self._prefill_jits[bucket] = self._make_paged_prefill(bucket)
+        padded = np.zeros(bucket, np.int32)
+        padded[:len(suffix)] = suffix
+        step_key, carry_key = jax.random.split(req.rng_key)
+        cache, tok = fn(
+            self.params, self.cache_manager.cache, jnp.asarray(padded),
+            jnp.asarray(len(suffix), jnp.int32),
+            jnp.asarray(shared, jnp.int32),
+            jnp.asarray(self.cache_manager.tables[lane]),
+            jnp.asarray(req.eos_token_id, jnp.int32),
+            jnp.asarray(req.min_new_tokens, jnp.int32),
+            jnp.asarray(req.greedy),
+            jnp.asarray(req.temperature, jnp.float32),
+            jnp.asarray(req.top_k, jnp.int32),
+            jnp.asarray(req.top_p, jnp.float32),
+            step_key,
+        )
+        self.cache_manager.cache = cache
+        self.cache_manager.register_prefix(lane, req.prompt)
+        pool = self.cache_manager.pool
+        self.metrics.record_prefix(
+            shared, req.prompt_len,
+            int(pool.alloc_counts[lane] - pool.shared_counts[lane]))
+        return tok, carry_key
+
+    def _admit(self, req: Request) -> None:
+        tok, carry_key = (self._paged_prefill(req) if self.paged
+                          else self._slot_prefill(req))
+        slot = req.slot
         tok = int(tok)  # host sync: the first token is now observable
         now = self._now()
         req.admit_time = req.first_token_time = now
@@ -572,11 +736,14 @@ class ServingEngine:
         else:
             self._active[slot] = req
 
-    def _decode_fn(self, params, cache, st, all_greedy: bool):
+    def _decode_fn(self, params, cache, st, tables, all_greedy: bool):
         """Jitted: ONE decode token for every slot (inactive slots ride
-        along with writes pinned to the last cache row, outputs ignored).
-        ``all_greedy`` is static — greedy-only ticks take a bare argmax and
-        skip the sampler's top-k sort / top-p bisection / rng split."""
+        along with writes pinned to the last cache row — which a freed
+        lane's zeroed block table re-routes to the trash page — outputs
+        ignored). ``tables`` is the device block tables on the paged path
+        (None on the slot path). ``all_greedy`` is static — greedy-only
+        ticks take a bare argmax and skip the sampler's top-k sort /
+        top-p bisection / rng split."""
         active = st["active"]
         lengths = st["lengths"]
         max_pos = self.model.cfg.max_position_embeddings
@@ -584,7 +751,8 @@ class ServingEngine:
         posid = jnp.where(active, jnp.minimum(lengths, max_pos - 1), 0)
         logits, cache = decode_step(
             self.model, params, cache, st["last_tok"][:, None],
-            posid[:, None], None, cache_positions=wpos)
+            posid[:, None], None, cache_positions=wpos,
+            block_tables=tables)
         step = logits[:, -1, :].astype(jnp.float32)
         vocab = step.shape[-1]
         suppress = ((st["decoded"] < st["min_new"])[:, None]
@@ -616,15 +784,29 @@ class ServingEngine:
         return cache, new_st, tok, done
 
     def _tick_decode(self):
+        retired = []
+        if self.paged:
+            # grow-on-demand BEFORE the write: any active lane whose next
+            # position crosses into an unallocated page claims one now; a
+            # dry pool retires the request with its partial tokens
+            # ("cache_full") — deterministic lowest-lane-first order
+            now = self._now()
+            for slot in sorted(self._active):
+                req = self._active[slot]
+                if not self.cache_manager.ensure_page(slot):
+                    self._evict(req, "cache_full", now)
+                    retired.append(req.id)
+            if not self._active:
+                return retired
         all_greedy = all(r.greedy for r in self._active.values())
         cache, st, tok, done = self._decode_jit(
-            self.params, self.cache_manager.cache, self._state, all_greedy)
+            self.params, self.cache_manager.cache, self._state,
+            self._device_tables(), all_greedy)
         self.cache_manager.cache = cache
         self._state = st
         tok_np = np.asarray(tok)  # host sync per tick
         done_np = np.asarray(done)
         now = self._now()
-        retired = []
         for slot, req in list(self._active.items()):
             t = int(tok_np[slot])
             req.tokens.append(t)

@@ -51,6 +51,18 @@ class ServingMetrics:
         self._occupancy_sum = 0
         self._first_token_t: Optional[float] = None
         self._last_token_t: Optional[float] = None
+        # paged-cache counters (zero/empty on the slot path so the
+        # snapshot schema is stable across modes)
+        self.prefix_queries = 0
+        self.prefix_hits = 0
+        self.prefill_tokens_saved = 0
+        self.prompt_tokens = 0
+        self.pages_per_request: List[int] = []
+        self.pages_in_use = 0
+        self.pages_total = 0
+        self._page_occupancy_sum = 0.0
+        self._page_occupancy_peak = 0.0
+        self._page_ticks = 0
 
     def record_submit(self) -> None:
         """A request entered the admission queue."""
@@ -76,6 +88,28 @@ class ServingMetrics:
     def record_reject(self) -> None:
         """A submit was refused by admission control (queue full)."""
         self.rejected += 1
+
+    def record_prefix(self, shared_tokens: int, prompt_tokens: int,
+                      pages: int) -> None:
+        """One paged admission: ``shared_tokens`` of the prompt came from
+        the prefix cache (their prefill was skipped), ``pages`` is the
+        FRESH pages the request claimed (trie-shared pages excluded —
+        they cost nothing, which is the point)."""
+        self.prefix_queries += 1
+        if shared_tokens > 0:
+            self.prefix_hits += 1
+        self.prefill_tokens_saved += int(shared_tokens)
+        self.prompt_tokens += int(prompt_tokens)
+        self.pages_per_request.append(int(pages))
+
+    def observe_pages(self, pages_in_use: int, pages_total: int) -> None:
+        """Per-tick page-pool gauge sample (paged mode only)."""
+        self.pages_in_use = pages_in_use
+        self.pages_total = pages_total
+        occ = pages_in_use / pages_total if pages_total else 0.0
+        self._page_occupancy_sum += occ
+        self._page_occupancy_peak = max(self._page_occupancy_peak, occ)
+        self._page_ticks += 1
 
     def record_retire(self, latency_s: float, reason: str) -> None:
         """A request finished (``reason``: eos | max_length | cache_full |
@@ -147,6 +181,25 @@ class ServingMetrics:
             "decode_tokens_per_s": (self.tokens_generated / span
                                     if span and span > 0 else None),
             "finish_reasons": dict(self.finish_reasons),
+            # paged-cache story: how much prefill the prefix trie saved
+            # and how full the page pool ran (zeros on the slot path)
+            "prefix_queries": self.prefix_queries,
+            "prefix_hits": self.prefix_hits,
+            "prefix_hit_rate": (self.prefix_hits / self.prefix_queries
+                                if self.prefix_queries else 0.0),
+            "prefill_tokens_saved": self.prefill_tokens_saved,
+            "prefill_tokens_saved_frac": (
+                self.prefill_tokens_saved / self.prompt_tokens
+                if self.prompt_tokens else 0.0),
+            "pages_per_request_mean": (
+                float(np.mean(self.pages_per_request))
+                if self.pages_per_request else None),
+            "pages_in_use": self.pages_in_use,
+            "pages_total": self.pages_total,
+            "page_occupancy_mean": (self._page_occupancy_sum
+                                    / self._page_ticks
+                                    if self._page_ticks else 0.0),
+            "page_occupancy_peak": self._page_occupancy_peak,
         }
 
     def log_snapshot(self) -> None:

@@ -72,6 +72,13 @@ class FIFOScheduler:
         """Next request to admit (None when the queue is empty)."""
         return self._queue.popleft() if self._queue else None
 
+    def peek(self) -> Optional[Request]:
+        """Next request WITHOUT removing it — the page-granular admission
+        path inspects the head's prompt (pages needed vs pages free) and
+        only pops once admission is certain, so a too-big head blocks
+        FIFO order instead of being silently dropped or reordered."""
+        return self._queue[0] if self._queue else None
+
     def remove(self, request_id: int) -> Optional[Request]:
         """Pull one queued request out by id (None if not queued) — the
         cancel() path for requests that never won a slot."""

@@ -113,6 +113,7 @@ def _score_sequence(model, params, seq, prompt_len, length_penalty, eos=EOS):
 
 
 @pytest.mark.parametrize("nb,length_penalty", [(2, 0.0), (4, 0.0), (4, 0.8)])
+@pytest.mark.slow  # 48.2s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_beam_matches_slow_reference(model_and_params, nb, length_penalty):
     """The compiled beam search must find a hypothesis whose score (under a
     common full-forward float64 scorer) matches the slow reference's optimum.
@@ -222,6 +223,7 @@ def test_left_padded_prompt_matches_unpadded_beam(model_and_params):
     np.testing.assert_array_equal(cont_plain, cont_padded)
 
 
+@pytest.mark.slow  # 14.0s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_right_sized_cache_matches_full_cache(model_and_params):
     """Decode output must be identical whether the kv cache is right-sized
     to prompt+max_length (the default) or allocated at the full

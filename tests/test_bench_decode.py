@@ -8,8 +8,10 @@ throughput records with the documented schema."""
 import importlib
 
 import numpy as np
+import pytest
 
 
+@pytest.mark.slow  # 18.4s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_decode_records_schema(monkeypatch, eight_devices):
     monkeypatch.setenv("BENCH_DECODE_TINY", "1")
     import tools.bench_decode as bd

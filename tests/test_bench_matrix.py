@@ -19,6 +19,7 @@ def test_log_parsing():
     assert bench_matrix.LOSS_RE.findall(log) == ["4.870062828"]
 
 
+@pytest.mark.slow  # 18.7s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_two_case_grid(monkeypatch, tmp_path):
     monkeypatch.setattr(bench_matrix, "CASES_8", {
         "DP8-MP1-PP1": {"Distributed.dp_degree": 8},

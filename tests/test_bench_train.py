@@ -5,10 +5,12 @@ import json
 import os
 import subprocess
 import sys
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow  # 244.3s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_bench_one_json_line_with_knobs():
     env = {
         **os.environ,

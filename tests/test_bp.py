@@ -17,6 +17,7 @@ def _mesh(cp):
     return Mesh(devs, ("cp",))
 
 
+@pytest.mark.slow  # 15.3s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_forward_matches_direct(eight_devices):
     mesh = _mesh(2)
     x = jnp.arange(24.0, dtype=jnp.float32).reshape(4, 6)
@@ -32,6 +33,7 @@ def test_forward_matches_direct(eight_devices):
 
 
 @pytest.mark.parametrize("cp", [2, 4])
+@pytest.mark.slow  # 27.3s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_grads_match_direct(eight_devices, cp):
     """Gradients through both branches — including a SHARED input feeding
     both (the pair_act case whose grad the reference all-reduces)."""
@@ -59,6 +61,7 @@ def test_grads_match_direct(eight_devices, cp):
         )
 
 
+@pytest.mark.slow  # 16.7s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_evoformer_tracks_branch_parallel(eight_devices):
     """The real use: one Evoformer block's MSA track and pair track as the
     two branches (the reference's exact split, evoformer.py:281-341), on

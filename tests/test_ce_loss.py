@@ -117,6 +117,7 @@ def test_kernels_lower_for_tpu():
         ce._interpret = orig
 
 
+@pytest.mark.slow  # 27.9s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_model_fused_ce_matches_logits_path():
     """GPTForPretraining(fused_ce) loss + grads == the logits path."""
     from fleetx_tpu.models.gpt.model import (
@@ -212,6 +213,7 @@ def test_module_demotes_fused_ce_when_ineligible(eight_devices, tmp_path):
     # test_module_fused_ce_allows_mp)
 
 
+@pytest.mark.slow  # 27.4s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_mesh_vocab_parallel_matches_unsharded(eight_devices):
     """mp2 (and dp2 x mp2): the embedding shards over the vocab dim and the
     global logsumexp/label-logit combine across shards — forward and both
@@ -314,6 +316,7 @@ def test_kernels_lower_for_tpu_64_block():
         ce._interpret = orig
 
 
+@pytest.mark.slow  # 12.9s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_mesh_vocab_parallel_64_block_shard(eight_devices):
     """mp2 over v=384: each shard is 192 = 64*3, exercising the 64-lane
     fallback through the vocab-parallel path end to end."""

@@ -44,6 +44,7 @@ def test_zigzag_block_order():
 
 @pytest.mark.parametrize("cp", [2, 4])
 @pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.slow  # 25.7s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_ring_matches_reference(eight_devices, cp, causal):
     q, k, v = _qkv()
     ref = causal_attention(q, k, v, causal=causal, use_flash=False)
@@ -88,6 +89,7 @@ def test_ring_self_attention_no_cp_fallback(eight_devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.slow  # 13.0s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_ring_gradients_match(eight_devices):
     cp = 4
     q, k, v = _qkv(s=16)

@@ -61,6 +61,7 @@ def _ring(q, k, v, mesh, cp, causal=True, rate=0.0, rng=None):
 
 @pytest.mark.parametrize("cp", [2, 4])
 @pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.slow  # 47.1s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_flash_ring_forward_matches_reference(eight_devices, flash_calls,
                                               cp, causal):
     q, k, v = _qkv(s=128)  # s_blk = 32 or 16: kernel path for both cps
@@ -73,6 +74,7 @@ def test_flash_ring_forward_matches_reference(eight_devices, flash_calls,
 
 
 @pytest.mark.parametrize("cp", [2, 4])
+@pytest.mark.slow  # 38.4s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_flash_ring_grads_match_reference(eight_devices, cp):
     """Custom-VJP ring backward (kv + dk/dv co-rotation) vs autodiff of the
     XLA reference. cp=4 exercises both hop-classifier branches."""
@@ -106,6 +108,7 @@ def test_flash_ring_dropout_matches_single_kernel(eight_devices):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow  # 31.5s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_flash_ring_dropout_grads_match_single_kernel(eight_devices):
     q, k, v = _qkv(s=64)
     rng = jax.random.PRNGKey(5)
@@ -127,6 +130,7 @@ def test_flash_ring_dropout_grads_match_single_kernel(eight_devices):
                                    err_msg=f"d{name} mismatch")
 
 
+@pytest.mark.slow  # 9.0s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_flash_ring_with_dp_mp_dropout(eight_devices):
     """cp2 x dp2 x mp2: batch/head axes sharded inside the same shard_map;
     the kernel's meta must globalize (batch, head) ids so the mask still
@@ -174,6 +178,7 @@ def test_cp2_lowering_contains_kernel_custom_call(eight_devices):
     assert any(local in ln for ln in call_lines), call_lines[0]
 
 
+@pytest.mark.slow  # 32.5s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_model_cp_attention_dropout_runs(eight_devices):
     """GPT with cp_degree=2 and attention dropout trains a step (used to
     raise NotImplementedError at models/gpt/model.py)."""
@@ -201,6 +206,7 @@ def test_model_cp_attention_dropout_runs(eight_devices):
     assert np.isfinite(np.asarray(logits)).all()
 
 
+@pytest.mark.slow  # 36.5s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_model_cp_flash_under_remat(eight_devices):
     """cp2 ring-flash inside nn.remat (selective recompute): the custom
     VJP must compose with jax.checkpoint over the scanned layer stack."""

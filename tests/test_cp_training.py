@@ -10,6 +10,7 @@ from fleetx_tpu.core.engine import Trainer
 from fleetx_tpu.models import build_module
 from fleetx_tpu.utils.config import get_config
 import fleetx_tpu.parallel.env as dist_env
+import pytest
 
 
 def _cfg(tmp_path, name, dp, cp, mp, nranks):
@@ -69,6 +70,7 @@ def _one_step_loss(cfg, batch):
     return float(metrics["loss"])
 
 
+@pytest.mark.slow  # 51.9s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_cp_matches_single_device_loss(tmp_path, eight_devices):
     rng = np.random.RandomState(0)
     batch = {
@@ -84,6 +86,7 @@ def test_cp_matches_single_device_loss(tmp_path, eight_devices):
     np.testing.assert_allclose(cp4, base, rtol=2e-4)
 
 
+@pytest.mark.slow  # 23.6s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_dp_fsdp_mp_match_single_device_loss(tmp_path, eight_devices):
     """dp8 / fsdp / 3D hybrid topologies must reproduce the single-device
     loss bit-for-bit up to reduction order: the parallelism is a layout

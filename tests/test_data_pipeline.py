@@ -47,6 +47,7 @@ def raw_corpus(tmp_path_factory):
     return str(d)
 
 
+@pytest.mark.slow  # 9.0s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_raw_to_json_to_tokens_to_training(tmp_path, raw_corpus, gpt_vocab,
                                            eight_devices):
     # stage 1: raw text -> jsonl

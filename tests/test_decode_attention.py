@@ -146,6 +146,7 @@ def test_greedy_parity_flash_vs_dense(monkeypatch, model_and_params):
     np.testing.assert_array_equal(dense, flash)
 
 
+@pytest.mark.slow  # 10.4s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_sampling_parity_flash_vs_dense(monkeypatch, model_and_params):
     """Fixed-rng sampling with every scalar post-process on (temperature,
     top-k, top-p, repetition penalty) must be byte-identical across paths —

@@ -141,6 +141,7 @@ def test_ernie_dataset_contract(ernie_data):
     assert sample["input_ids"][0] == 1
 
 
+@pytest.mark.slow  # 13.0s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_ernie_module_end_to_end(tmp_path, ernie_data, eight_devices):
     from fleetx_tpu.core.engine import Trainer
     from fleetx_tpu.models import build_module

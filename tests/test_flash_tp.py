@@ -38,6 +38,7 @@ def _mesh(eight_devices, dp=1, fsdp=1, mp=1):
 
 @pytest.mark.parametrize("degrees", [dict(mp=2), dict(dp=2, mp=2),
                                      dict(dp=2, fsdp=2, mp=2)])
+@pytest.mark.slow  # 10.0s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_mesh_forward_bitwise_matches_unsharded(eight_devices, degrees):
     # b=4 so every degree set divides the batch and the wrapper ENGAGES
     # (dp2 x fsdp2 needs 4 | b; an indivisible batch silently declines,
@@ -61,6 +62,7 @@ def test_mesh_dropout_mask_is_layout_invariant(eight_devices):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+@pytest.mark.slow  # 9.8s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_mesh_grads_match_unsharded(eight_devices):
     q, k, v = _qkv(d=32)
     rng = jax.random.PRNGKey(3)

@@ -192,6 +192,7 @@ def _tiny_cfg(**over):
     return FoldingConfig(**base)
 
 
+@pytest.mark.slow  # 30.9s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_trunk_forward_shapes_and_finiteness():
     rng = np.random.RandomState(0)
     batch = {k: jnp.asarray(v) for k, v in _trunk_batch(rng).items()}
@@ -208,6 +209,7 @@ def test_trunk_forward_shapes_and_finiteness():
         assert np.isfinite(np.asarray(v, np.float32)).all()
 
 
+@pytest.mark.slow  # 15.2s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_trunk_without_templates_or_recycling():
     rng = np.random.RandomState(1)
     full = _trunk_batch(rng)
@@ -220,6 +222,7 @@ def test_trunk_without_templates_or_recycling():
     assert np.isfinite(np.asarray(out["pair"], np.float32)).all()
 
 
+@pytest.mark.slow  # 22.7s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_template_mask_zeroes_contribution():
     """With template_mask all-zero the template embedding contributes
     exactly nothing to the pair activations."""
@@ -241,6 +244,7 @@ def test_template_mask_zeroes_contribution():
         np.asarray(out_a["pair"]), np.asarray(out_b["pair"]), atol=2e-4)
 
 
+@pytest.mark.slow  # 30.4s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_trunk_dap_sharded_execution(eight_devices):
     """The trunk must run sharded over the cp (DAP) axis: jit with dap rules
     on a cp=4 mesh, assert the compiled module contains axial collectives
@@ -293,6 +297,7 @@ def test_trunk_dap_sharded_execution(eight_devices):
 
 # ------------------------------------------------- module + trainer e2e
 
+@pytest.mark.slow  # 69.7s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_protein_module_trains_with_dap(eight_devices, tmp_path):
     from fleetx_tpu.core.engine import Trainer
     from fleetx_tpu.models import build_module

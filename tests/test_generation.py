@@ -31,6 +31,7 @@ def model_and_params():
     return model, params
 
 
+@pytest.mark.slow  # 9.4s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_cached_decode_matches_full_forward(model_and_params):
     """Prefill+decode through the cache must reproduce the dense forward."""
     model, params = model_and_params
@@ -83,6 +84,7 @@ def test_greedy_generate_deterministic(model_and_params):
     np.testing.assert_array_equal(np.asarray(out1[:, :6]), np.asarray(prompt))
 
 
+@pytest.mark.slow  # 8.2s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_greedy_matches_stepwise_argmax(model_and_params):
     """Greedy generate must equal manually argmax-ing the dense forward."""
     model, params = model_and_params

@@ -142,6 +142,7 @@ def test_classification_head_shapes():
     assert model.apply(vars_, toks, seq_lens=lens).shape == (2, 3)
 
 
+@pytest.mark.slow  # 10.1s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_finetune_end_to_end_beats_chance(tmp_path, eight_devices):
     from fleetx_tpu.core.engine import Trainer
     from fleetx_tpu.data import build_dataloader

@@ -81,6 +81,7 @@ def test_scan_vs_unrolled_same_loss():
 
 
 @pytest.mark.parametrize("granularity", ["full", "full_attn", "core_attn"])
+@pytest.mark.slow  # 18.8s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_recompute_matches_no_recompute(granularity):
     tokens, labels, mask = _data()
     base = GPTForPretraining(TINY)

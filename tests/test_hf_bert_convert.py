@@ -32,6 +32,7 @@ def tiny_bert_ckpt(tmp_path_factory):
     return str(d), model
 
 
+@pytest.mark.slow  # 10.5s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_converted_encoder_matches_transformers(tmp_path, tiny_bert_ckpt):
     hf_dir, hf_model = tiny_bert_ckpt
     sys.path.insert(0, REPO)
@@ -65,6 +66,7 @@ def test_converted_encoder_matches_transformers(tmp_path, tiny_bert_ckpt):
     )
 
 
+@pytest.mark.slow  # 16.9s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_cli_artifact_serves(tmp_path, tiny_bert_ckpt):
     hf_dir, _ = tiny_bert_ckpt
     out = str(tmp_path / "artifact")

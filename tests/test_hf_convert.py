@@ -30,6 +30,7 @@ def tiny_hf_ckpt(tmp_path_factory):
     return str(d), model
 
 
+@pytest.mark.slow  # 11.2s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_converted_logits_match_transformers(tmp_path, tiny_hf_ckpt):
     hf_dir, hf_model = tiny_hf_ckpt
     out = str(tmp_path / "artifact")
@@ -54,6 +55,7 @@ def test_converted_logits_match_transformers(tmp_path, tiny_hf_ckpt):
     np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow  # 12.3s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_vocab_padding_preserves_real_logits(tmp_path, tiny_hf_ckpt):
     hf_dir, hf_model = tiny_hf_ckpt
     out = str(tmp_path / "artifact_padded")
@@ -76,6 +78,7 @@ def test_vocab_padding_preserves_real_logits(tmp_path, tiny_hf_ckpt):
     np.testing.assert_allclose(ours[..., :97], theirs, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow  # 14.4s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_gpt_module_warm_starts_from_converted_artifact(tmp_path, tiny_hf_ckpt):
     """Model.pretrained on the pretraining module loads a converted HF
     backbone (eval/generation warm-start path)."""
@@ -125,6 +128,7 @@ def test_gpt_module_warm_starts_from_converted_artifact(tmp_path, tiny_hf_ckpt):
     np.testing.assert_allclose(params["gpt"]["word_embeddings"], wte, atol=1e-6)
 
 
+@pytest.mark.slow  # 16.2s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_int8_quantized_artifact_close_to_fp32(tmp_path, tiny_hf_ckpt):
     """--quantize int8 stores int8 weights; served logits stay close to the
     fp32 artifact (weight-only per-channel quantization)."""

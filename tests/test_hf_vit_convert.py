@@ -32,6 +32,7 @@ def tiny_vit_ckpt(tmp_path_factory):
     return str(d), model
 
 
+@pytest.mark.slow  # 9.8s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_converted_logits_match_transformers(tmp_path, tiny_vit_ckpt):
     hf_dir, hf_model = tiny_vit_ckpt
     sys.path.insert(0, REPO)
@@ -61,6 +62,7 @@ def test_converted_logits_match_transformers(tmp_path, tiny_vit_ckpt):
     np.testing.assert_allclose(np.asarray(ours), theirs, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow  # 15.7s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_cli_artifact_serves(tmp_path, tiny_vit_ckpt):
     hf_dir, hf_model = tiny_vit_ckpt
     out = str(tmp_path / "artifact")

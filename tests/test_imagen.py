@@ -54,6 +54,7 @@ def test_q_sample_and_criterion():
     assert float(l0) != float(l1)
 
 
+@pytest.mark.slow  # 84.3s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_unet_shapes_and_presets():
     assert set(UNET_PRESETS) == {"Unet64_397M", "BaseUnet64", "SRUnet256",
                                  "SRUnet1024"}
@@ -69,6 +70,7 @@ def test_unet_shapes_and_presets():
         build_unet("NoSuchUnet")
 
 
+@pytest.mark.slow  # 34.5s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_sr_unet_lowres_conditioning():
     cfg = UNetConfig(**{**TINY.__dict__, "lowres_cond": True,
                         "memory_efficient": True})
@@ -83,6 +85,7 @@ def test_sr_unet_lowres_conditioning():
         model.apply(vars_, x, t, None, None, None)
 
 
+@pytest.mark.slow  # 13.3s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_ddpm_sampler_shapes():
     model = EfficientUNet(TINY)
     x = jnp.zeros((1, 16, 16, 3))
@@ -131,6 +134,7 @@ def test_imagen_export_serving_contract(tmp_path):
     assert "labels" not in loaded_spec
 
 
+@pytest.mark.slow  # 46.9s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_imagen_module_end_to_end(tmp_path, eight_devices):
     from fleetx_tpu.core.engine import Trainer
     from fleetx_tpu.data import build_dataloader

@@ -12,6 +12,7 @@ import numpy as np
 from fleetx_tpu.models.vision.resnet import ResNetConfig, ResNet, build_resnet
 
 
+@pytest.mark.slow  # 55.1s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_resnet_backbone_shapes():
     model = build_resnet("resnet18", width=16, dtype=jnp.float32)
     imgs = jnp.zeros((2, 32, 32, 3))
@@ -80,6 +81,7 @@ def _moco_cfg(tmp_path, nranks=8):
     return cfg
 
 
+@pytest.mark.slow  # 23.5s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_moco_end_to_end_queue_and_ema(tmp_path, eight_devices):
     from fleetx_tpu.core.engine import Trainer
     from fleetx_tpu.data import build_dataloader
@@ -122,6 +124,7 @@ def test_moco_end_to_end_queue_and_ema(tmp_path, eight_devices):
     assert changed > 0  # but key != query
 
 
+@pytest.mark.slow  # 17.1s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_moco_trains_with_fit(tmp_path, eight_devices):
     from fleetx_tpu.core.engine import Trainer
     from fleetx_tpu.data import build_dataloader
@@ -135,6 +138,7 @@ def test_moco_trains_with_fit(tmp_path, eight_devices):
     assert int(trainer.state.step) == 4
 
 
+@pytest.mark.slow  # 24.2s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_moco_lincls_loads_pretrained_backbone(tmp_path, eight_devices):
     """MOCOClsModule maps the MoCo encoder backbone onto the linear probe
     (frozen), errors on checkpoints with nothing to transfer, and its decay

@@ -10,6 +10,7 @@ from fleetx_tpu.models.gpt.model import GPTConfig
 from fleetx_tpu.parallel.moe import MoEMLP, compute_routing
 
 
+@pytest.mark.slow  # 15.3s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_routing_top1_all_tokens_placed_when_capacity_ample():
     logits = jnp.asarray(np.random.RandomState(0).randn(32, 4), jnp.float32)
     dispatch, combine, aux = compute_routing(logits, top_k=1, capacity=32,
@@ -21,6 +22,7 @@ def test_routing_top1_all_tokens_placed_when_capacity_ample():
     assert np.isfinite(float(aux))
 
 
+@pytest.mark.slow  # 17.0s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_routing_capacity_drops_tokens():
     # all tokens prefer expert 0 -> only `capacity` fit
     logits = jnp.tile(jnp.asarray([[10.0, 0.0]]), (16, 1))
@@ -33,6 +35,7 @@ def test_routing_capacity_drops_tokens():
     assert np.allclose(np.asarray(combine[~placed]).sum(), 0.0)
 
 
+@pytest.mark.slow  # 14.5s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_routing_no_slot_collisions():
     rng = np.random.RandomState(1)
     logits = jnp.asarray(rng.randn(64, 8), jnp.float32)
@@ -49,6 +52,7 @@ def test_top2_weights_normalized():
     np.testing.assert_allclose(sums, 1.0, rtol=1e-5)
 
 
+@pytest.mark.slow  # 41.7s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_moe_mlp_forward_and_grad():
     cfg = GPTConfig(
         hidden_size=32, ffn_hidden_size=64, num_experts=4, expert_mode=True,
@@ -163,6 +167,7 @@ def test_scatter_dispatch_matches_einsum():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow  # 44.4s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_moe_e16_on_mesh_with_capacity_drops(eight_devices):
     """E=16 experts sharded over the 8-device data axes with the scatter
     dispatch: runs, differentiates, and the tight capacity actually drops

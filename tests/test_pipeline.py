@@ -36,6 +36,7 @@ def _remap_scan_params_to_pipeline(v_seq, pp, layers_per_stage):
     return sequential_params_to_pipeline({"params": unboxed}, pp)
 
 
+@pytest.mark.slow  # 16.5s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_pipeline_param_remap_roundtrip():
     from fleetx_tpu.parallel.pipeline import (
         maybe_pipeline_params_to_sequential,
@@ -60,6 +61,7 @@ def test_pipeline_param_remap_roundtrip():
     assert maybe_pipeline_params_to_sequential(v) is v
 
 
+@pytest.mark.slow  # 31.0s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_pipeline_matches_sequential():
     seq_model = GPTForPretraining(GPTConfig(**BASE))
     pipe_model = GPTForPretraining(
@@ -77,6 +79,7 @@ def test_pipeline_matches_sequential():
     )
 
 
+@pytest.mark.slow  # 53.3s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_pipeline_grads_match_sequential():
     from fleetx_tpu.models.gpt.model import pretraining_loss
 
@@ -128,6 +131,7 @@ def test_pipeline_grads_match_sequential():
         )
 
 
+@pytest.mark.slow  # 9.4s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_pp_training_step_on_mesh(tmp_path, eight_devices):
     from fleetx_tpu.core.engine import Trainer
     from fleetx_tpu.models import build_module
@@ -202,6 +206,7 @@ def test_pp_training_step_on_mesh(tmp_path, eight_devices):
     assert qkv.shape[0] == 2  # [pp, Lp, ...]
 
 
+@pytest.mark.slow  # 10.1s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_pipeline_per_example_mask_matches_sequential():
     """A padded batch (per-example attention masks) must stream through the
     stages with its microbatch and reproduce the sequential output
@@ -234,6 +239,7 @@ def test_pipeline_per_example_mask_matches_sequential():
 
 
 @pytest.mark.parametrize("pp,v", [(2, 2), (4, 2)])
+@pytest.mark.slow  # 71.3s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_virtual_pipeline_matches_sequential(pp, v):
     """pp x virtual chunks: outputs AND grads must match the sequential
     stack (VERDICT r2 item 10 done-criterion)."""

@@ -66,6 +66,7 @@ def _pretrain_export(tmp_path):
 
 
 @pytest.mark.parametrize("fuse", [False, True])
+@pytest.mark.slow  # 29.1s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_finetune_loads_pretrained_with_qkv_conversion(tmp_path, eight_devices, fuse):
     export_dir, src = _pretrain_export(tmp_path)
     text = textwrap.dedent(

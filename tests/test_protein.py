@@ -43,6 +43,7 @@ def _inputs(seed=0):
     )
 
 
+@pytest.mark.slow  # 37.1s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_iteration_shapes():
     msa, pair, mm, pm = _inputs()
     model = EvoformerIteration(CFG)
@@ -66,6 +67,7 @@ def _randomize(vars_, seed=1):
     return jax.tree.unflatten(treedef, leaves)
 
 
+@pytest.mark.slow  # 44.5s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_stack_identity_at_init_and_updates_when_randomized():
     msa, pair, mm, pm = _inputs()
     model = EvoformerStack(CFG)
@@ -92,6 +94,7 @@ def test_triangle_mult_directions_differ():
     assert not np.allclose(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # 8.0s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_outer_product_mean_mask_semantics():
     msa, _, mm, _ = _inputs()
     model = OuterProductMean(CFG)
@@ -107,6 +110,7 @@ def test_outer_product_mean_mask_semantics():
     assert not np.allclose(np.asarray(full), np.asarray(masked))
 
 
+@pytest.mark.slow  # 9.6s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_msa_row_mask_hides_residues():
     """Row attention at masked residues must not influence others."""
     msa, pair, mm, pm = _inputs()

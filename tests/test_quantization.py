@@ -11,6 +11,7 @@ import jax.numpy as jnp
 from fleetx_tpu.utils.config import AttrDict, get_config, process_configs
 
 
+@pytest.mark.slow  # 8.1s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_fake_quant_ste_gradient():
     from fleetx_tpu.ops.quant import fake_quant
 
@@ -76,6 +77,7 @@ def test_qat_trains_with_falling_loss(tmp_path, eight_devices):
     assert losses[-1] < losses[0] - 0.3, losses
 
 
+@pytest.mark.slow  # 30.1s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_int8_export_logit_drift(tmp_path, eight_devices):
     from fleetx_tpu.core.inference_engine import InferenceEngine
     from fleetx_tpu.models import build_module
@@ -125,6 +127,7 @@ def test_qat_config_zoo_builds():
         assert module.quant_enabled and module.quant_bits == 8
 
 
+@pytest.mark.slow  # 13.0s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_act_quant_interceptor_changes_forward(tmp_path, eight_devices):
     """With activation_quantize_type set, the Dense-input interceptor must
     actually engage: the quantized-forward loss differs from the weight-only

@@ -7,6 +7,7 @@ not change the math — only the memory/recompute tradeoff.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from fleetx_tpu.models.gpt.model import (
     GPTConfig,
@@ -44,6 +45,7 @@ def _cfg(**kw):
     return GPTConfig(**base)
 
 
+@pytest.mark.slow  # 24.5s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_extra_saves_do_not_change_math():
     l0, g0 = _loss_and_grads(_cfg())
     l1, g1 = _loss_and_grads(_cfg(
@@ -55,6 +57,7 @@ def test_extra_saves_do_not_change_math():
     )
 
 
+@pytest.mark.slow  # 8.4s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_full_granularity_with_saves_is_graded():
     pol = _remat_policy(_cfg(recompute_granularity="full",
                              recompute_extra_saves=("ffn_gelu",)))

@@ -72,6 +72,7 @@ def test_rigid_is_a_pytree():
     assert out.shape == (5, 3)
 
 
+@pytest.mark.slow  # 8.8s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_quat_multiply_matches_rotation_composition():
     rng = np.random.RandomState(4)
     a, b = _random_rigid(rng), _random_rigid(rng)

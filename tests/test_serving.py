@@ -63,6 +63,7 @@ def _one_shot_tokens(model, params, prompt, max_length, eos=10**6):
 
 # --------------------------------------------------- the acceptance parity
 
+@pytest.mark.slow  # 72.0s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_staggered_mixed_length_parity(model_and_params):
     """8 requests, mixed prompt AND decode lengths, staggered admission,
     slots=3 (forces queueing + slot reuse): every request's continuous-
@@ -118,6 +119,7 @@ def test_eos_retirement_frees_slot_and_matches_one_shot(model_and_params):
         "eos": 1, "max_length": 1}
 
 
+@pytest.mark.slow  # 38.5s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_slot_reuse_many_requests_few_slots(model_and_params):
     """9 requests through 2 slots: every slot is reused multiple times and
     parity still holds for each tenant."""
@@ -136,6 +138,7 @@ def test_slot_reuse_many_requests_few_slots(model_and_params):
     assert eng.cache_manager.free_count == 2
 
 
+@pytest.mark.slow  # 21.6s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_flash_decode_per_slot_windows(model_and_params, monkeypatch):
     """Continuous batching over the Pallas flash-decode kernel (interpret
     mode): per-slot ``end`` windows through the kernel must reproduce the

@@ -10,6 +10,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = __file__.rsplit("/tests/", 1)[0]
 
@@ -72,10 +73,12 @@ def test_precompute_text_embeddings_hash(tmp_path):
 
 
 def test_bench_serving_records_schema(monkeypatch):
-    """Static-vs-continuous serving bench on the tiny CPU config: both
-    modes produce finite throughput records with the documented schema,
-    and the continuous run's tokens are byte-identical to static's
-    (detail.parity — the bench doubles as a scheduling-only comparison)."""
+    """Serving bench on the tiny CPU config: static, continuous, and
+    shared-prefix modes all produce finite throughput records with the
+    documented schema, continuous tokens are byte-identical to static's
+    (detail.parity — the bench doubles as a scheduling-only comparison),
+    and the shared-prefix mode's warm pass reports the prefix-reuse
+    counters (hit rate, prefill tokens saved, page occupancy)."""
     monkeypatch.setenv("BENCH_SERVING_TINY", "1")
     sys.path.insert(0, REPO)
     import tools.bench_serving as bs
@@ -84,8 +87,9 @@ def test_bench_serving_records_schema(monkeypatch):
     recs = bs.serving_records(n_requests=6, slots=2)
     assert [r["metric"] for r in recs] == [
         "gpt_345m_serving_static", "gpt_345m_serving_continuous",
+        "gpt_345m_serving_shared_prefix",
     ]
-    static, cont = recs
+    static, cont, shared = recs
     for r in recs:
         assert r["unit"] == "tokens/s"
         assert np.isfinite(r["value"]) and r["value"] > 0
@@ -101,8 +105,17 @@ def test_bench_serving_records_schema(monkeypatch):
     assert cont["detail"]["useful_tokens"] == static["detail"]["useful_tokens"]
     assert cont["detail"]["dead_token_frac"] == 0.0
     assert static["detail"]["generated_tokens"] >= static["detail"]["useful_tokens"]
+    # the shared-prefix warm pass must actually hit the trie — every
+    # request reuses the system prompt's full pages — byte-identically
+    # to its own trie-cold pass
+    d = shared["detail"]
+    assert d["parity"] is True
+    assert d["prefix_hit_rate"] == 1.0
+    assert d["prefill_tokens_saved"] > 0
+    assert 0 < d["page_occupancy_peak"] <= 1
 
 
+@pytest.mark.slow  # 9.8s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_chaos_check_sentry_scenario(tmp_path):
     """The chaos smoke driver's sentry scenario passes in-process (the
     full sweep is tests/test_resilience.py; this proves the CLI works)."""

@@ -83,6 +83,7 @@ def _batches(cfg, n, seq=32, seed=0):
     return out
 
 
+@pytest.mark.slow  # 17.4s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_fit_loss_decreases(tmp_path, eight_devices):
     cfg = _cfg(tmp_path)
     module = build_module(cfg)
@@ -102,6 +103,7 @@ def test_fit_loss_decreases(tmp_path, eight_devices):
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow  # 10.1s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_fit_api_and_eval(tmp_path, eight_devices, capsys):
     cfg = _cfg(tmp_path)
     module = build_module(cfg)
@@ -113,6 +115,7 @@ def test_fit_api_and_eval(tmp_path, eight_devices, capsys):
     assert np.isfinite(loss)
 
 
+@pytest.mark.slow  # 12.2s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_grad_accumulation_matches_big_batch(tmp_path, eight_devices):
     """Accumulated grads (accum=2, micro=2) must equal the one-shot grads
     (accum=1, micro=4) on the same data. Compared pre-optimizer: Adam's
@@ -142,6 +145,7 @@ def test_grad_accumulation_matches_big_batch(tmp_path, eight_devices):
         np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-6)
 
 
+@pytest.mark.slow  # 10.5s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_save_load_resume(tmp_path, eight_devices):
     import jax
 
@@ -169,6 +173,7 @@ def test_save_load_resume(tmp_path, eight_devices):
 
 
 @pytest.mark.parametrize("stage", [1, 3])
+@pytest.mark.slow  # 14.2s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_sharding_stages_run(tmp_path, eight_devices, stage):
     cfg = _cfg(tmp_path)
     cfg.Distributed.sharding.sharding_stage = stage
@@ -179,6 +184,7 @@ def test_sharding_stages_run(tmp_path, eight_devices, stage):
     assert int(trainer.state.step) == 2
 
 
+@pytest.mark.slow  # 14.8s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_predict_matches_direct_forward(tmp_path, eight_devices):
     """Trainer.predict (reference eager_engine.py:502-632) feeds the serving
     contract and returns per-batch host logits equal to a direct apply."""
@@ -201,6 +207,7 @@ def test_predict_matches_direct_forward(tmp_path, eight_devices):
     np.testing.assert_allclose(outs[0], np.asarray(direct), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # 19.1s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_profiler_window_and_summary(tmp_path, eight_devices):
     """Profiler config traces a [lo, hi] step window and then prints the
     summary views (reference eager_engine.py:761-820). Captured via a
@@ -246,6 +253,7 @@ def test_profiler_window_and_summary(tmp_path, eight_devices):
     assert os.path.isdir(str(tmp_path / "prof"))
 
 
+@pytest.mark.slow  # 8.3s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_preemption_sigterm_checkpoints_and_resumes(tmp_path, eight_devices):
     """SIGTERM mid-fit checkpoints the current step and exits cleanly; a
     fresh trainer resumes from it (TPU preemption path; the reference has
@@ -280,6 +288,7 @@ def test_preemption_sigterm_checkpoints_and_resumes(tmp_path, eight_devices):
     assert int(trainer2.state.step) == saved_step
 
 
+@pytest.mark.slow  # 10.0s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_sigterm_with_pending_async_save_finalizes(tmp_path, eight_devices):
     """SIGTERM arriving while a periodic async save is still in flight:
     the grace-window save must finalize BOTH checkpoints (no
@@ -318,6 +327,7 @@ def test_sigterm_with_pending_async_save_finalizes(tmp_path, eight_devices):
     assert int(trainer2.state.step) == saved_step
 
 
+@pytest.mark.slow  # 8.6s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_sentry_skip_resume_epoch_and_consumed_samples(tmp_path, eight_devices):
     """A sentry-skipped step still consumed its batch: after save/restore
     the resumed trainer reports the skipped batch in consumed_samples and

@@ -22,6 +22,7 @@ TINY = ViTConfig(
 )
 
 
+@pytest.mark.slow  # 17.6s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_vit_forward_shapes():
     model = ViT(TINY)
     imgs = jnp.zeros((2, 32, 32, 3))
@@ -38,6 +39,7 @@ def test_presets_table():
         build_vision_model("ViT_nonexistent")
 
 
+@pytest.mark.slow  # 15.1s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_droppath_train_vs_eval():
     cfg = ViTConfig(**{**TINY.__dict__, "drop_path_rate": 0.5})
     model = ViT(cfg)
@@ -75,6 +77,7 @@ def test_synthetic_dataset_and_transforms(tmp_path):
     np.testing.assert_array_equal(ev[1]["images"], ev[1]["images"])
 
 
+@pytest.mark.slow  # 9.0s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_cls_module_end_to_end(tmp_path, eight_devices):
     from fleetx_tpu.core.engine import Trainer
     from fleetx_tpu.data import build_dataloader

@@ -16,6 +16,13 @@ Both modes decode greedily with EOS disabled, so they emit byte-identical
 tokens per request (asserted, `detail.parity`) and the comparison is pure
 scheduling: useful-tokens/s, TTFT, queue depth, slot occupancy.
 
+A third record exercises the PAGED cache's shared-prefix reuse: every
+request carries the same system prompt, run twice through one paged
+engine — the first pass populates the prefix trie (and compiles), the
+timed pass hits it — reporting prefix hit rate, prefill tokens saved,
+page occupancy, and fresh pages/request next to the usual TTFT and
+tokens/s (byte parity between cold-trie and warm-trie passes asserted).
+
 Standalone:  python tools/bench_serving.py
 In-process:  from tools.bench_serving import serving_records
 """
@@ -40,6 +47,8 @@ N_REQUESTS = 8 if _TINY else 32
 SLOTS = 3 if _TINY else 8
 PROMPT_RANGE = (3, 9) if _TINY else (32, 192)
 GEN_RANGE = (3, 9) if _TINY else (16, 160)
+# shared-prefix mode: the "system prompt" every request carries
+PREFIX_LEN = 8 if _TINY else 128
 
 
 def _model():
@@ -73,6 +82,22 @@ def _workload(n: int):
         plen = rng.randint(PROMPT_RANGE[0], PROMPT_RANGE[1] + 1)
         gen = rng.randint(GEN_RANGE[0], GEN_RANGE[1] + 1)
         out.append((rng.randint(0, VOCAB, plen).astype(np.int32), int(gen)))
+    return out
+
+
+def _shared_prefix_workload(n: int):
+    """Every request = the SAME system prompt + a short unique tail: the
+    prefix-trie's target shape (a thousand chat users, one template)."""
+    rng = np.random.RandomState(1)
+    prefix = rng.randint(0, VOCAB, PREFIX_LEN).astype(np.int32)
+    tail_max = max(PROMPT_RANGE[1] - PREFIX_LEN, 1)
+    out = []
+    for _ in range(n):
+        tail = rng.randint(1, tail_max + 1)
+        gen = rng.randint(GEN_RANGE[0], GEN_RANGE[1] + 1)
+        prompt = np.concatenate(
+            [prefix, rng.randint(0, VOCAB, tail).astype(np.int32)])
+        out.append((prompt, int(gen)))
     return out
 
 
@@ -174,13 +199,29 @@ def _run_continuous(engine, workload):
         "ttft_ms_p50": round(snap["ttft_ms_p50"], 2),
         "ttft_ms_p95": round(snap["ttft_ms_p95"], 2),
     }
+    if getattr(engine, "paged", False):
+        detail.update({
+            "prefix_hit_rate": round(snap["prefix_hit_rate"], 3),
+            "prefill_tokens_saved": snap["prefill_tokens_saved"],
+            "prefill_tokens_saved_frac": round(
+                snap["prefill_tokens_saved_frac"], 3),
+            "page_occupancy_mean": round(snap["page_occupancy_mean"], 3),
+            "page_occupancy_peak": round(snap["page_occupancy_peak"], 3),
+            "pages_per_request_mean": (
+                None if snap["pages_per_request_mean"] is None
+                else round(snap["pages_per_request_mean"], 2)),
+            "pages_total": snap["pages_total"],
+        })
     return results, elapsed, detail
 
 
 def serving_records(n_requests: int = N_REQUESTS, slots: int = SLOTS):
-    """One JSON-able record per serving mode (static, continuous), plus a
-    byte-parity assertion between them. Each mode gets an untimed warmup
-    pass so compile time doesn't masquerade as scheduling cost."""
+    """One JSON-able record per serving mode (static, continuous,
+    shared_prefix), plus byte-parity assertions between them. Each mode
+    gets an untimed warmup pass so compile time doesn't masquerade as
+    scheduling cost; the shared-prefix warmup doubles as the trie-cold
+    pass, so its timed pass reports the warm steady state a production
+    template workload sees."""
     import jax
 
     from fleetx_tpu.models.gpt.generation import GenerationConfig
@@ -211,10 +252,29 @@ def serving_records(n_requests: int = N_REQUESTS, slots: int = SLOTS):
         np.array_equal(a, b) for a, b in zip(static_toks, cont_toks)
     )
     cont_detail["parity"] = parity
+
+    # shared-prefix mode: paged engine, trie-cold warmup then warm timing
+    sp_workload = _shared_prefix_workload(n_requests)
+    sp_engine = ServingEngine(model, variables, slots=slots,
+                              cache_len=model.cfg.max_position_embeddings,
+                              gen_cfg=gen_cfg, paged=True,
+                              # tiny prompts need tiny pages or the 8-token
+                              # system prompt never fills a shareable page
+                              page_size=8 if _TINY else None,
+                              prefill_bucket=8 if _TINY else 32)
+    cold_toks, _, _ = _run_continuous(sp_engine, sp_workload)
+    sp_toks, _, sp_detail = _run_continuous(sp_engine, sp_workload)
+    # trie reuse must not change a single byte of any request's tokens
+    sp_detail["parity"] = all(
+        np.array_equal(a, b) for a, b in zip(cold_toks, sp_toks)
+    )
+    sp_detail["prefix_len"] = PREFIX_LEN
+
     device = getattr(jax.devices()[0], "device_kind", "?")
     records = []
     for mode, detail in (("static", static_detail),
-                         ("continuous", cont_detail)):
+                         ("continuous", cont_detail),
+                         ("shared_prefix", sp_detail)):
         detail["device"] = device
         records.append({
             "metric": f"gpt_345m_serving_{mode}",
