@@ -4,4 +4,22 @@ Capability parity target: PaddleFleetX (see SURVEY.md). Idiomatic JAX:
 one device mesh, GSPMD sharding rules, jitted train step, Pallas kernels.
 """
 
+import os as _os
+
+import jax as _jax
+
+# Sharding-invariant PRNG. The legacy (non-partitionable) threefry lowering
+# lets GSPMD produce DIFFERENT random bits depending on how the generating
+# computation is partitioned — concretely, param init under a cp×mp mesh
+# (4+ devices, transposed tile assignments) silently diverged from the
+# single-device init (~1% first-step loss skew that looked like a ring-
+# attention bug; see tests/test_cp_training.py::test_threefry_partitionable
+# for the pinned-down repro). Partitionable threefry makes random values a
+# pure function of (key, shape) regardless of mesh/sharding — the only
+# sane semantics for a toolkit whose whole premise is "parallelism is a
+# layout choice, not a math change". FLEETX_THREEFRY_PARTITIONABLE=0
+# restores the legacy stream (e.g. to reproduce old checkpoints' inits).
+if _os.environ.get("FLEETX_THREEFRY_PARTITIONABLE", "1") == "1":
+    _jax.config.update("jax_threefry_partitionable", True)
+
 __version__ = "0.1.0"

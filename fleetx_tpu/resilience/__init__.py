@@ -8,6 +8,12 @@ injection points are compiled into the hot paths but cost one global
 flag check when inert. docs/RESILIENCE.md has the full tour.
 """
 
-from fleetx_tpu.resilience.faults import FaultPlan, faults
+from fleetx_tpu.resilience.faults import (
+    FaultPlan,
+    PoisonFault,
+    PrefillFault,
+    TickFault,
+    faults,
+)
 
-__all__ = ["FaultPlan", "faults"]
+__all__ = ["FaultPlan", "PoisonFault", "PrefillFault", "TickFault", "faults"]

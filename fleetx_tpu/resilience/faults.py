@@ -24,6 +24,26 @@ that keeps the injection deterministic under skip/resume):
 - ``FLEETX_FAULT_CKPT_SAVE_STEP``: ``Trainer.save`` raises ``CkptFault``
   at the matching step numbers (full disk / flaky object store).
 
+Serving injection points (exercised by the crash-safe serving story,
+docs/RESILIENCE.md; indices count *attempted* device calls, so a
+retried-after-recovery tick consumes a fresh index and a one-shot
+selector faults exactly once):
+
+- ``FLEETX_FAULT_TICK_RAISE``: the matching decode ticks raise
+  ``TickFault`` before the device step (an XLA/device error mid-tick).
+- ``FLEETX_FAULT_PREFILL_RAISE``: the matching prefill attempts raise
+  ``PrefillFault`` (a prompt whose prefill reliably dies).
+- ``FLEETX_FAULT_TICK_HANG`` / ``FLEETX_FAULT_TICK_HANG_S``: sleep
+  ``FLEETX_FAULT_TICK_HANG_S`` seconds inside the matching decode ticks
+  (a wedged device step — what the engine watchdog's
+  ``FLEETX_SERVING_TICK_TIMEOUT_S`` is for).
+- ``FLEETX_FAULT_POISON_REQUEST``: selector over *request ids* — any
+  decode tick whose active set contains a matching request raises
+  ``PoisonFault`` (the deterministic poison request the engine's
+  bisection quarantine isolates). Decode-only by design: a poison that
+  dies in its own prefill is already isolated (the engine knows who it
+  was admitting) and is covered by ``FLEETX_FAULT_PREFILL_RAISE``.
+
 Batch/step selectors share one grammar: a comma-separated list of
 entries, each either an int (``"3"``), or ``"N+"`` for every index >= N
 (``"0+"`` = always). :func:`raising_on_token` builds the deterministic
@@ -44,6 +64,9 @@ __all__ = [
     "DataFault",
     "FaultInjector",
     "FaultPlan",
+    "PoisonFault",
+    "PrefillFault",
+    "TickFault",
     "faults",
     "raising_on_token",
 ]
@@ -55,6 +78,20 @@ class DataFault(RuntimeError):
 
 class CkptFault(IOError):
     """Injected checkpoint-write failure (FLEETX_FAULT_CKPT_SAVE_STEP)."""
+
+
+class TickFault(RuntimeError):
+    """Injected serving decode-tick failure (FLEETX_FAULT_TICK_RAISE)."""
+
+
+class PrefillFault(RuntimeError):
+    """Injected serving prefill failure (FLEETX_FAULT_PREFILL_RAISE)."""
+
+
+class PoisonFault(RuntimeError):
+    """Injected poison-request failure (FLEETX_FAULT_POISON_REQUEST): the
+    decode batch contained a request whose presence reliably kills the
+    device step."""
 
 
 class _Selector:
@@ -89,29 +126,42 @@ class FaultPlan:
     data_slow_batch: Optional[str] = None
     data_slow_s: float = 0.05
     ckpt_save_step: Optional[str] = None
+    tick_raise: Optional[str] = None
+    prefill_raise: Optional[str] = None
+    tick_hang: Optional[str] = None
+    tick_hang_s: float = 30.0
+    poison_request: Optional[str] = None
 
     @classmethod
     def from_env(cls, env=os.environ) -> Optional["FaultPlan"]:
         """Build a plan from ``FLEETX_FAULT_*`` (None when none are set).
         Malformed values raise a ValueError naming the offending var — a
         chaos run must fail loudly, never silently skip its faults."""
-        slow_s = 0.05
-        raw = env.get("FLEETX_FAULT_DATA_SLOW_S")
-        if raw:
+        def _float(name, default):
+            raw = env.get(name)
+            if not raw:
+                return default
             try:
-                slow_s = float(raw)
+                return float(raw)
             except ValueError:
-                raise ValueError(
-                    f"FLEETX_FAULT_DATA_SLOW_S={raw!r} is not a float")
+                raise ValueError(f"{name}={raw!r} is not a float")
+
         plan = cls(
             nan_batch=env.get("FLEETX_FAULT_NAN_BATCH") or None,
             data_raise_batch=env.get("FLEETX_FAULT_DATA_RAISE_BATCH") or None,
             data_slow_batch=env.get("FLEETX_FAULT_DATA_SLOW_BATCH") or None,
-            data_slow_s=slow_s,
+            data_slow_s=_float("FLEETX_FAULT_DATA_SLOW_S", 0.05),
             ckpt_save_step=env.get("FLEETX_FAULT_CKPT_SAVE_STEP") or None,
+            tick_raise=env.get("FLEETX_FAULT_TICK_RAISE") or None,
+            prefill_raise=env.get("FLEETX_FAULT_PREFILL_RAISE") or None,
+            tick_hang=env.get("FLEETX_FAULT_TICK_HANG") or None,
+            tick_hang_s=_float("FLEETX_FAULT_TICK_HANG_S", 30.0),
+            poison_request=env.get("FLEETX_FAULT_POISON_REQUEST") or None,
         )
         if not (plan.nan_batch or plan.data_raise_batch
-                or plan.data_slow_batch or plan.ckpt_save_step):
+                or plan.data_slow_batch or plan.ckpt_save_step
+                or plan.tick_raise or plan.prefill_raise or plan.tick_hang
+                or plan.poison_request):
             return None
         return plan
 
@@ -119,18 +169,25 @@ class FaultPlan:
 class FaultInjector:
     """Process-global injector: holds the active plan + fetch counters."""
 
+    _ZERO = {"nan": 0, "data_raise": 0, "data_slow": 0, "ckpt": 0,
+             "tick_raise": 0, "prefill_raise": 0, "tick_hang": 0,
+             "poison": 0}
+
     def __init__(self):
         self._plan: Optional[FaultPlan] = None
         self._nan_sel = self._raise_sel = self._slow_sel = self._ckpt_sel = None
+        self._tick_sel = self._prefill_sel = self._hang_sel = None
+        self._poison_sel = None
         self._batch_counter = 0
-        self.injected = {"nan": 0, "data_raise": 0, "data_slow": 0, "ckpt": 0}
+        self.injected = dict(self._ZERO)
 
     # ----------------------------------------------------------- configure
     def configure(self, plan: Optional[FaultPlan] = None, **kw) -> None:
         """Install ``plan`` (or build one from kwargs); resets counters."""
         if plan is None and kw:
             plan = FaultPlan(**{k: str(v) if v is not None
-                                and k.endswith(("batch", "step")) else v
+                                and k.endswith(("batch", "step", "raise",
+                                                "hang", "request")) else v
                                 for k, v in kw.items()})
         def sel(field):
             spec = getattr(plan, field, None) if plan else None
@@ -148,8 +205,12 @@ class FaultInjector:
         self._raise_sel = sel("data_raise_batch")
         self._slow_sel = sel("data_slow_batch")
         self._ckpt_sel = sel("ckpt_save_step")
+        self._tick_sel = sel("tick_raise")
+        self._prefill_sel = sel("prefill_raise")
+        self._hang_sel = sel("tick_hang")
+        self._poison_sel = sel("poison_request")
         self._batch_counter = 0
-        self.injected = {"nan": 0, "data_raise": 0, "data_slow": 0, "ckpt": 0}
+        self.injected = dict(self._ZERO)
 
     def configure_from_env(self, env=os.environ) -> None:
         """Re-read ``FLEETX_FAULT_*`` into the active plan."""
@@ -211,6 +272,45 @@ class FaultInjector:
             self.injected["ckpt"] += 1
             raise CkptFault(f"injected checkpoint-write failure at step "
                             f"{step} (FLEETX_FAULT_CKPT_SAVE_STEP)")
+
+    def on_serving_tick(self, tick: int) -> None:
+        """Counter-indexed decode-tick faults: hang (sleep) and/or raise
+        when attempt index ``tick`` matches. Called INSIDE the engine's
+        watchdog-guarded device call, so an injected hang is what the
+        ``FLEETX_SERVING_TICK_TIMEOUT_S`` monitor sees."""
+        if self._plan is None:
+            return
+        if self._hang_sel and tick in self._hang_sel:
+            self.injected["tick_hang"] += 1
+            time.sleep(self._plan.tick_hang_s)
+        if self._tick_sel and tick in self._tick_sel:
+            self.injected["tick_raise"] += 1
+            raise TickFault(f"injected decode-tick failure at tick {tick} "
+                            "(FLEETX_FAULT_TICK_RAISE)")
+
+    def on_serving_prefill(self, attempt: int, request_id: int) -> None:
+        """Raise :class:`PrefillFault` when prefill-attempt ``attempt``
+        matches (attempts count every prefill device call, replays
+        included)."""
+        if self._prefill_sel and attempt in self._prefill_sel:
+            self.injected["prefill_raise"] += 1
+            raise PrefillFault(
+                f"injected prefill failure at attempt {attempt} "
+                f"(request {request_id}, FLEETX_FAULT_PREFILL_RAISE)")
+
+    def on_serving_batch(self, request_ids) -> None:
+        """Raise :class:`PoisonFault` when any id in ``request_ids`` is a
+        configured poison request. The engine calls this for real decode
+        ticks AND for bisection probe subsets — exactly the semantics of a
+        request whose presence kills any batch containing it."""
+        if self._poison_sel is None:
+            return
+        hits = [int(r) for r in request_ids if int(r) in self._poison_sel]
+        if hits:
+            self.injected["poison"] += 1
+            raise PoisonFault(
+                f"injected poison-request failure (requests {hits} in the "
+                "decode batch, FLEETX_FAULT_POISON_REQUEST)")
 
 
 def raising_on_token(after_tokens: int = 1, record: Optional[list] = None):

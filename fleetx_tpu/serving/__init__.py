@@ -32,8 +32,11 @@ from fleetx_tpu.serving.cache_manager import (
 )
 from fleetx_tpu.serving.engine import (
     QueueFull,
+    RecoveryExhausted,
     ServingEngine,
     ServingResult,
+    ShuttingDown,
+    TickTimeout,
     sample_tokens,
 )
 from fleetx_tpu.serving.metrics import ServingMetrics
@@ -41,8 +44,11 @@ from fleetx_tpu.serving.scheduler import FIFOScheduler, Request
 
 __all__ = [
     "QueueFull",
+    "RecoveryExhausted",
     "ServingEngine",
     "ServingResult",
+    "ShuttingDown",
+    "TickTimeout",
     "PagePool",
     "PagedKVCacheManager",
     "SlotKVCacheManager",

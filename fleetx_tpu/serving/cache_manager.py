@@ -389,6 +389,59 @@ class PagePool:
         self.version += 1
         return True
 
+    def check_invariants(self) -> None:
+        """Assert the pool's conservation/refcount invariants; raises
+        AssertionError with a specific message on any breach. The chaos
+        suite calls this after EVERY injected failure — a rolled-back or
+        recovered tick must leave the allocator exactly as consistent as a
+        clean one (docs/RESILIENCE.md)."""
+        # conservation: every usable page is free, cached, or lane-held
+        held = set()
+        for lane in range(self.lanes):
+            n = int(self.alloc_counts[lane])
+            for i in range(n):
+                p = int(self.tables[lane, i])
+                assert p != 0, f"lane {lane} logical page {i} maps to trash"
+                held.add(p)
+            for i in range(n, self.lane_pages):
+                assert self.tables[lane, i] == 0, (
+                    f"lane {lane} logical page {i} beyond alloc_count {n} "
+                    f"is {self.tables[lane, i]}, not trash")
+        free = set(self._free)
+        cached = set(self._cached)
+        assert not (free & cached), f"pages both free and cached: {free & cached}"
+        assert not (free & held), f"pages both free and lane-held: {free & held}"
+        assert not (cached & held), (
+            f"pages both cached and lane-held: {cached & held}")
+        assert free | cached | held == set(range(1, self.num_pages)), (
+            "page conservation broken: "
+            f"{len(free)} free + {len(cached)} cached + {len(held)} held "
+            f"!= {self.num_pages - 1} usable")
+        # refcounts: trash pinned, cached zero-ref, held = #lanes holding
+        assert self.ref[0] >= 1, "trash page unpinned"
+        counts = {p: 0 for p in range(1, self.num_pages)}
+        for lane in range(self.lanes):
+            for i in range(int(self.alloc_counts[lane])):
+                counts[int(self.tables[lane, i])] += 1
+        for p in range(1, self.num_pages):
+            want = counts[p]
+            assert self.ref[p] == want, (
+                f"page {p} refcount {self.ref[p]} != {want} lane holders")
+            if p in cached or p in free:
+                assert want == 0
+        # trie: every cached page has a live node; parent >= child refs
+        for p, node in self._cached.items():
+            assert self._node_of_page.get(p) is node, (
+                f"cached page {p} lost its trie node")
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            for c in n.children.values():
+                assert self.ref[c.page] <= self.ref[n.page], (
+                    f"trie child page {c.page} (ref {self.ref[c.page]}) "
+                    f"outlives parent {n.page} (ref {self.ref[n.page]})")
+                stack.append(c)
+
     def free(self, lane: int) -> None:
         """Release every page of ``lane``'s chain (refcount--). Zero-ref
         pages return to the free stack — unless they are trie-registered,

@@ -49,6 +49,39 @@ immediately. A raising ``on_token`` callback retires only ITS request
 (``finish_reason="error"``) — neighbors' token streams are untouched.
 With no limits configured every knob is inert and token outputs are
 byte-identical to the unlimited engine.
+
+Crash safety (docs/RESILIENCE.md serving-recovery):
+
+- **Transactional ticks** — ``step()`` snapshots the pure-host
+  bookkeeping (scheduler queue, request table, active map, results)
+  before any device work and rolls it back on ANY exception, so a failed
+  tick never loses or duplicates a token, a request, or a queue position.
+- **Replay recovery** — device caches are pure functions of each
+  request's ``prompt + emitted tokens``, so :meth:`ServingEngine.recover`
+  rebuilds a fresh cache/pool/lane-table and re-prefills every active
+  request's full history (the prefix trie makes shared prompts cheap),
+  resuming byte-identically after a rolled-back tick or an external
+  device reset. Bounded by ``FLEETX_SERVING_MAX_RECOVERIES`` consecutive
+  recoveries without a productive tick → :class:`RecoveryExhausted`.
+- **Poison quarantine** — a decode tick that fails again right after a
+  recovery triggers bisection probing over the active set (non-donating
+  probe ticks whose outputs are discarded) to isolate the request whose
+  presence kills the batch; it is retired ``finish_reason="error"`` with
+  its partial tokens and every neighbor continues byte-identically. A
+  prefill that fails twice for the same request retires that request
+  directly — no bisection needed, the culprit is known.
+- **Watchdog** — with ``FLEETX_SERVING_TICK_TIMEOUT_S`` > 0 device calls
+  run on a monitor-thread executor; a tick exceeding the timeout banks
+  diagnostics in ``engine.hang_diagnostics`` and raises
+  :class:`TickTimeout` into the same rollback→recovery path (the hung
+  call is abandoned; recovery rebuilds fresh buffers).
+- **Graceful drain** — :meth:`shutdown` (or SIGTERM via
+  :meth:`install_sigterm_handler` → :meth:`request_shutdown`) stops
+  admission (:class:`ShuttingDown` rejects at submit), keeps ticking so
+  in-flight AND queued work finishes inside the grace window, then
+  retires whatever remains with partial tokens and
+  ``finish_reason="shutdown"`` — the hook a multi-replica router needs
+  to rotate a replica out without dropping a byte.
 """
 
 from __future__ import annotations
@@ -74,11 +107,20 @@ from fleetx_tpu.serving.cache_manager import (
     SlotKVCacheManager,
     scatter_slot,
 )
+from fleetx_tpu.resilience.faults import faults
 from fleetx_tpu.serving.metrics import ServingMetrics
 from fleetx_tpu.serving.scheduler import FIFOScheduler, Request
 from fleetx_tpu.utils.log import logger
 
-__all__ = ["QueueFull", "ServingEngine", "ServingResult", "sample_tokens"]
+__all__ = [
+    "QueueFull",
+    "RecoveryExhausted",
+    "ServingEngine",
+    "ServingResult",
+    "ShuttingDown",
+    "TickTimeout",
+    "sample_tokens",
+]
 
 _NEG = -1e9
 
@@ -87,6 +129,25 @@ class QueueFull(RuntimeError):
     """Admission refused: the queue is at ``FLEETX_SERVING_MAX_QUEUE``.
     The explicit backpressure signal — callers shed load or retry later;
     the engine never buffers unboundedly under overload."""
+
+
+class ShuttingDown(RuntimeError):
+    """Admission refused: the engine is draining toward shutdown
+    (``QueueFull``-style explicit reject — a router in front of N
+    replicas routes around a draining one instead of queueing into it)."""
+
+
+class TickTimeout(RuntimeError):
+    """A device tick exceeded ``FLEETX_SERVING_TICK_TIMEOUT_S``. Raised by
+    the watchdog into the transactional-tick rollback, which then runs the
+    recovery path; diagnostics are banked in ``engine.hang_diagnostics``."""
+
+
+class RecoveryExhausted(RuntimeError):
+    """More than ``FLEETX_SERVING_MAX_RECOVERIES`` consecutive recoveries
+    without a productive tick: the fault is not request-shaped (quarantine
+    would have cleared it), so the engine declares itself dead rather than
+    spin forever — the caller restarts the process/device."""
 
 
 def _env_int(name: str, default: int) -> int:
@@ -145,7 +206,10 @@ class ServingResult:
     id: int
     prompt: np.ndarray
     tokens: np.ndarray  # generated tokens (EOS included when hit)
-    finish_reason: str  # eos | max_length | cache_full | timeout | cancelled | error
+    # eos | max_length | cache_full | timeout | cancelled | error | shutdown
+    # ("error" covers raising callbacks AND quarantined poison requests;
+    # "shutdown" = graceful-drain grace window closed, partial tokens kept)
+    finish_reason: str
     ttft_s: float
     latency_s: float
 
@@ -172,7 +236,10 @@ class ServingEngine:
                  paged: Optional[bool] = None,
                  page_size: Optional[int] = None,
                  num_pages: Optional[int] = None,
-                 prefix_cache: Optional[bool] = None):
+                 prefix_cache: Optional[bool] = None,
+                 max_recoveries: Optional[int] = None,
+                 tick_timeout_s: Optional[float] = None,
+                 grace_s: Optional[float] = None):
         gen_cfg = gen_cfg or GenerationConfig(decode_strategy="greedy")
         if gen_cfg.repetition_penalty != 1.0:
             raise ValueError("continuous batching does not support "
@@ -236,6 +303,27 @@ class ServingEngine:
                             else _env_float("FLEETX_SERVING_QUEUE_TTL_S", 0.0))
         self.deadline_s = (deadline_s if deadline_s is not None
                            else _env_float("FLEETX_SERVING_DEADLINE_S", 0.0))
+        # crash safety (module docstring): recovery budget, hung-tick
+        # watchdog, graceful-drain grace window
+        self.max_recoveries = (
+            max_recoveries if max_recoveries is not None
+            else _env_int("FLEETX_SERVING_MAX_RECOVERIES", 8))
+        self.tick_timeout_s = (
+            tick_timeout_s if tick_timeout_s is not None
+            else _env_float("FLEETX_SERVING_TICK_TIMEOUT_S", 0.0))
+        self.grace_s = (grace_s if grace_s is not None
+                        else _env_float("FLEETX_SERVING_GRACE_S", 30.0))
+        self._recoveries_consecutive = 0
+        self._tick_strikes = 0              # consecutive failed decode ticks
+        self._prefill_strikes: Dict[int, int] = {}  # request id -> failures
+        self._fault_ctx = None              # ("prefill", rid) during prefill
+        self._fault_ticks = 0               # attempted decode device calls
+        self._fault_prefills = 0            # attempted prefill device calls
+        self._watchdog = None               # lazy single-thread executor
+        self.hang_diagnostics = None        # banked by the watchdog
+        self._shutting_down = False
+        self._shutdown_deadline = None
+        self._prev_sigterm = None
         self._now = time.perf_counter  # swappable clock (chaos tests)
         if self.paged:
             self.cache_manager = PagedKVCacheManager(
@@ -263,6 +351,9 @@ class ServingEngine:
         self._decode_jit = jax.jit(
             self._decode_fn, static_argnums=(4,),
             donate_argnums=(1, 2) if donate else ())
+        # bisection probes: NO donation — a probe's discarded outputs must
+        # leave the committed cache/state buffers untouched
+        self._probe_jit = jax.jit(self._decode_fn, static_argnums=(4,))
         self._admit_jit = jax.jit(self._admit_fn, donate_argnums=())
         self._deactivate_jit = jax.jit(_deactivate)
         self._prefill_jits = {}  # bucketed prompt length -> jitted prefill
@@ -285,7 +376,14 @@ class ServingEngine:
         ``(request_id, token, finished)`` per decoded token.
         ``queue_ttl_s``/``deadline_s`` override the engine's admission
         limits (0 disables). Raises :class:`QueueFull` when the bounded
-        queue is at ``FLEETX_SERVING_MAX_QUEUE``."""
+        queue is at ``FLEETX_SERVING_MAX_QUEUE`` and :class:`ShuttingDown`
+        once :meth:`shutdown`/:meth:`request_shutdown` has been called."""
+        if self._shutting_down:
+            self.metrics.record_drain_reject()
+            raise ShuttingDown(
+                "engine is draining toward shutdown; submit to another "
+                "replica (in-flight requests are finishing under the "
+                "grace window)")
         if self.max_queue and self.scheduler.queue_depth >= self.max_queue:
             # dead entries must not hold live ones out: sweep TTL/deadline
             # expiries before judging the bound (step() normally does this,
@@ -358,32 +456,80 @@ class ServingEngine:
         return rid
 
     def step(self) -> Dict:
-        """One scheduler tick: queued-expiry sweep, admissions, one batched
-        decode step, retirements, active-deadline sweep. Returns a small
-        summary dict (``timed_out`` lists this tick's deadline victims)."""
+        """One TRANSACTIONAL scheduler tick: the pure-host bookkeeping
+        (scheduler queue, request table, active map, results) is
+        snapshotted before any device work; any exception rolls it back to
+        the exact pre-tick state and runs the recovery path (module
+        docstring), so the caller's ticking loop just keeps ticking.
+        Returns a summary dict (``timed_out`` lists this tick's deadline
+        victims; ``recovered`` marks a rolled-back-and-recovered tick).
+        Raises only :class:`RecoveryExhausted` (the engine is dead)."""
+        t0 = self._now()
+        if (self._shutting_down and self._shutdown_deadline is not None
+                and t0 >= self._shutdown_deadline
+                and (len(self.scheduler) or self._active)):
+            # grace window over: everything still in flight returns NOW
+            # with its partial tokens
+            retired = self._retire_all("shutdown")
+            summary = {"admitted": 0, "decoded": 0, "retired": retired,
+                       "timed_out": []}
+        else:
+            # phase-granular transaction: the snapshot re-commits after
+            # every successful admission, so a decode fault rolls back ONLY
+            # the decode (admitted requests stay admitted — their prefill
+            # device work is real and their first token was emitted), and a
+            # prefill fault rolls back only the admission in flight. No
+            # phase ever commits partially.
+            snap = self._snapshot()
+
+            def commit():
+                snap.clear()
+                snap.update(self._snapshot())
+
+            try:
+                summary = self._step_inner(commit)
+                if summary["decoded"] or summary["admitted"]:
+                    # a productive device tick proves the engine is healthy
+                    # again — re-arm the recovery budget and strike counts
+                    self._recoveries_consecutive = 0
+                    if summary["decoded"]:
+                        self._tick_strikes = 0
+            except RecoveryExhausted:
+                raise
+            except Exception as exc:  # noqa: BLE001 — THE crash-safety seam
+                summary = self._handle_tick_fault(snap, exc)
+        self._ticks += 1
+        self.metrics.observe_tick(self.scheduler.queue_depth,
+                                  len(self._active), self._now() - t0)
+        if self.paged:
+            self.metrics.observe_pages(self.cache_manager.pages_in_use,
+                                       self.cache_manager.usable_pages)
+        if self.log_every and self._ticks % self.log_every == 0:
+            self.metrics.log_snapshot()
+        summary.setdefault("recovered", False)
+        summary["queue_depth"] = self.scheduler.queue_depth
+        summary["active_slots"] = len(self._active)
+        return summary
+
+    def _step_inner(self, commit=lambda: None) -> Dict:
+        """The actual tick body: queued-expiry sweep, admissions, one
+        batched decode step, retirements, active-deadline sweep.
+        ``commit`` re-bases the transactional snapshot after each
+        completed phase (see :meth:`step`)."""
         timed_out = self._expire_queued(self._now())
         admitted = 0
         while len(self.scheduler) and self._can_admit(self.scheduler.peek()):
             self._admit(self.scheduler.pop_next())
             admitted += 1
+            commit()  # an admission that completed stays admitted
         decoded = len(self._active)
         retired = []
         if decoded:
             retired = self._tick_decode()
         # fresh clock: prefill/decode above may have eaten the deadline
         timed_out += self._expire_active(self._now())
-        self._ticks += 1
-        self.metrics.observe_tick(self.scheduler.queue_depth,
-                                  len(self._active))
-        if self.paged:
-            self.metrics.observe_pages(self.cache_manager.pages_in_use,
-                                       self.cache_manager.usable_pages)
-        if self.log_every and self._ticks % self.log_every == 0:
-            self.metrics.log_snapshot()
         return {"admitted": admitted, "decoded": decoded,
-                "retired": retired + timed_out, "timed_out": timed_out,
-                "queue_depth": self.scheduler.queue_depth,
-                "active_slots": len(self._active)}
+                "retired": retired + timed_out, "timed_out": timed_out}
 
     def cancel(self, request_id: int) -> bool:
         """Cancel a queued or in-flight request: its slot (if any) is freed
@@ -429,6 +575,347 @@ class ServingEngine:
             self._state = self._deactivate_jit(
                 self._state, jnp.asarray(req.slot, jnp.int32))
         self._finalize(req, reason, now)
+
+    # ------------------------------------------------------- crash safety
+
+    def _snapshot(self):
+        """Capture the pure-host bookkeeping a tick can mutate. Device
+        state is deliberately NOT captured: a failed device call may have
+        consumed donated buffers, so rollback restores host truth and
+        :meth:`recover` rebuilds the device side from it. Metrics stay
+        monotonic (a rolled-back tick's gauge samples are not unwound)."""
+        reqs = list(self.scheduler.snapshot()) + list(self._active.values())
+        return {
+            "queue": self.scheduler.snapshot(),
+            "active": dict(self._active),
+            "results": dict(self._results),
+            # per-request mutable fields the tick touches; tokens rolls
+            # back by truncating to its pre-tick length (the list object
+            # itself is kept, appends are what a failed tick added)
+            "reqs": [(r, r.slot, r.admit_time, r.first_token_time,
+                      len(r.tokens)) for r in reqs],
+        }
+
+    def _restore(self, snap) -> None:
+        self.scheduler.restore(snap["queue"])
+        self._active = snap["active"]
+        self._results = snap["results"]
+        for r, slot, admit_t, first_t, ntok in snap["reqs"]:
+            r.slot = slot
+            r.admit_time = admit_t
+            r.first_token_time = first_t
+            del r.tokens[ntok:]
+
+    def _handle_tick_fault(self, snap, exc: Exception) -> Dict:
+        """Rollback + recovery + escalation for one failed tick. Token
+        streams are untouched (nothing the failed tick produced was
+        committed); the queue and every request are exactly pre-tick."""
+        ctx, self._fault_ctx = self._fault_ctx, None
+        self._restore(snap)
+        victim = ctx[1] if ctx else None
+        logger.error(
+            "serving: tick %d failed (%s: %s)%s; host state rolled back, "
+            "running replay recovery", self._ticks, type(exc).__name__, exc,
+            f" during prefill of request {victim}" if ctx else "")
+        if ctx:
+            self._prefill_strikes[victim] = (
+                self._prefill_strikes.get(victim, 0) + 1)
+        else:
+            self._tick_strikes += 1
+        retired = list(self.recover())
+        if ctx and self._prefill_strikes.get(victim, 0) >= 2:
+            # a prefill that failed, survived a recovery, and failed again
+            # is a poison prompt — and unlike a decode fault, the culprit
+            # is already known: the request being admitted
+            req = self.scheduler.remove(victim)
+            if req is not None:
+                logger.error(
+                    "serving: quarantining request %d — its prefill failed "
+                    "%d times across a recovery; finish_reason='error'",
+                    victim, self._prefill_strikes[victim])
+                self._finalize(req, "error", self._now())
+                self.metrics.record_poison()
+                retired.append(victim)
+            self._prefill_strikes.pop(victim, None)
+        elif not ctx and self._tick_strikes >= 2:
+            # the decode tick failed again right after a recovery: some
+            # active request is poison — bisect to find it
+            retired += self._bisect_poison()
+            self._tick_strikes = 0
+        return {"admitted": 0, "decoded": 0, "retired": retired,
+                "timed_out": [], "recovered": True}
+
+    def recover(self):
+        """Replay recovery: rebuild the device caches, lane table, and
+        page pool from host truth, re-prefilling every active request's
+        ``prompt + emitted tokens`` (prefix-trie sharing makes common
+        prompts one prefill) and reconstructing its decode-lane scalars —
+        including the per-request RNG stream position, so sampling
+        requests also resume byte-identically. Public: call it after an
+        external device reset too. The warm prefix cache (retired
+        requests' parked pages) is dropped — a correctness-neutral loss.
+        Returns the ids of requests retired because their own replay
+        failed (their fault followed them into recovery — poison)."""
+        self._recoveries_consecutive += 1
+        self.metrics.record_recovery()
+        if self._recoveries_consecutive > self.max_recoveries:
+            raise RecoveryExhausted(
+                f"{self._recoveries_consecutive - 1} consecutive recoveries "
+                f"without a productive tick (FLEETX_SERVING_MAX_RECOVERIES="
+                f"{self.max_recoveries}); the fault is not request-shaped — "
+                "restart the engine/device")
+        old_active = sorted(self._active.items())
+        self._active = {}
+        self._tables_dev = None
+        self._tables_version = -1
+        self._state = self._init_state()
+        if self.paged:
+            self.cache_manager = PagedKVCacheManager(
+                self.model, self.slots, self.cache_len, self.num_pages,
+                self.page_size, prefix_cache=self.prefix_cache)
+        else:
+            self.cache_manager = SlotKVCacheManager(self.model, self.slots,
+                                                    self.cache_len)
+        retired = []
+        for _, req in old_active:
+            req.slot = None
+            try:
+                self._replay(req)
+            except Exception:  # noqa: BLE001 — isolate, don't cascade
+                logger.exception(
+                    "serving: request %d failed its own replay during "
+                    "recovery; quarantining it (finish_reason='error', %d "
+                    "partial tokens kept)", req.id, len(req.tokens))
+                if req.slot is not None:
+                    self.cache_manager.free(req.slot)
+                    req.slot = None
+                self._finalize(req, "error", self._now())
+                self.metrics.record_poison()
+                retired.append(req.id)
+                continue
+            self._active[req.slot] = req
+        logger.warning(
+            "serving: recovery #%d complete — %d request(s) replayed, %d "
+            "quarantined", self.metrics.engine_recoveries,
+            len(self._active), len(retired))
+        return retired
+
+    def _replay(self, req: Request) -> None:
+        """Re-admit one in-flight request into the rebuilt engine: prefill
+        its full history (all K/V the decode loop had written: prompt plus
+        every emitted token except the last, whose K/V write is the next
+        tick's job) and reinstall its lane scalars with ``last_tok`` = the
+        last emitted token, ready to decode the next one."""
+        n = len(req.tokens)
+        history = np.concatenate(
+            [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
+        if self.paged:
+            alloc = self.cache_manager.alloc(req.id, history)
+            if alloc is None:
+                raise RuntimeError(
+                    f"replay alloc failed for request {req.id} "
+                    f"({len(history)} history tokens; "
+                    f"{self.cache_manager.pool.free_pages} pages free)")
+            lane, shared = alloc
+            req.slot = lane
+            self._paged_prefill_call(req, history[shared:], shared, lane,
+                                     replay=True)
+            self.cache_manager.register_prefix(lane, req.prompt)
+        else:
+            slot = self.cache_manager.alloc(req.id, len(history))
+            if slot is None:
+                raise RuntimeError(
+                    f"replay alloc failed for request {req.id}: no free slot")
+            req.slot = slot
+            self._slot_prefill_call(req, history, slot, replay=True)
+        # reconstruct the request's RNG stream position: one split at
+        # admit, one per decode tick it was active in (greedy requests
+        # never consume their stream, so the value is irrelevant there)
+        carry = req.rng_key
+        if not req.greedy:
+            carry = jax.random.split(carry)[1]
+            for _ in range(n - 1):
+                carry = jax.random.split(carry)[1]
+        self._install_lane(
+            req, tok=int(req.tokens[-1]), length=len(history), decoded=n,
+            active=True, carry_key=carry)
+
+    def _probe_fails(self, slots) -> bool:
+        """Run one NON-COMMITTING decode tick over a subset of the active
+        lanes (outputs discarded; ``_probe_jit`` never donates, so the
+        committed cache/state buffers are untouched). True iff the device
+        call — or the poison injector — raised for this subset."""
+        reqs = [self._active[s] for s in slots]
+        mask = np.zeros(self.slots, bool)
+        mask[list(slots)] = True
+        st = dict(self._state)
+        st["active"] = self._state["active"] & jnp.asarray(mask)
+        all_greedy = all(r.greedy for r in reqs)
+        ids = [r.id for r in reqs]
+        # operands bound on the main thread (same zombie-safety argument as
+        # _tick_decode: an abandoned probe must never see post-recovery
+        # objects)
+        cache_in, tables_in = self.cache_manager.cache, self._device_tables()
+
+        def run():
+            faults.on_serving_batch(ids)
+            out = self._probe_jit(self.params, cache_in, st, tables_in,
+                                  all_greedy)
+            return jax.block_until_ready(out)
+
+        try:
+            self._run_device(run)
+            return False
+        except Exception:  # noqa: BLE001 — a probe exists to catch these
+            return True
+
+    def _bisect_poison(self):
+        """Binary-search the active set for the request whose presence
+        kills the decode step; retire it with its partial tokens. Finds
+        one poison per escalation — multiple poisons fall out across
+        successive escalations. Returns the retired ids ([] when the
+        failure does not reproduce under probing, e.g. a transient)."""
+        if not self._active:
+            return []
+        suspects = sorted(self._active)
+        if not self._probe_fails(suspects):
+            logger.warning(
+                "serving: decode failures did not reproduce under probing "
+                "(transient device fault?); no quarantine")
+            return []
+        while len(suspects) > 1:
+            half = suspects[:len(suspects) // 2]
+            suspects = (half if self._probe_fails(half)
+                        else suspects[len(suspects) // 2:])
+        slot = suspects[0]
+        req = self._active[slot]
+        if not self._probe_fails([slot]):
+            logger.warning(
+                "serving: bisection could not pin the failure to a single "
+                "request (fault needs a specific combination?); no "
+                "quarantine this round")
+            return []
+        logger.error(
+            "serving: quarantining poison request %d (lane %d) isolated by "
+            "bisection; finish_reason='error', %d partial token(s) kept — "
+            "neighbors continue untouched", req.id, slot, len(req.tokens))
+        self._evict(req, "error", self._now())
+        self.metrics.record_poison()
+        return [req.id]
+
+    def _run_device(self, fn):
+        """Run one device call under the hung-tick watchdog. With
+        ``FLEETX_SERVING_TICK_TIMEOUT_S`` unset this is a direct call
+        (zero overhead); with a timeout the call runs on a persistent
+        monitor-thread executor and exceeding the budget raises
+        :class:`TickTimeout` into the transactional-tick rollback. The
+        abandoned call's thread is orphaned (a truly hung XLA call cannot
+        be interrupted from Python) and its buffers are never reused —
+        recovery rebuilds fresh ones."""
+        if not self.tick_timeout_s or self.tick_timeout_s <= 0:
+            return fn()
+        import concurrent.futures
+
+        if self._watchdog is None:
+            self._watchdog = concurrent.futures.ThreadPoolExecutor(
+                1, thread_name_prefix="fleetx-serving-watchdog")
+        fut = self._watchdog.submit(fn)
+        try:
+            return fut.result(timeout=self.tick_timeout_s)
+        except concurrent.futures.TimeoutError:
+            self._watchdog.shutdown(wait=False)  # abandon the zombie call
+            self._watchdog = None
+            self.hang_diagnostics = {
+                "tick": self._ticks,
+                "timeout_s": self.tick_timeout_s,
+                "active_requests": sorted(r.id for r in
+                                          self._active.values()),
+                "queue_depth": self.scheduler.queue_depth,
+                "recoveries": self.metrics.engine_recoveries,
+            }
+            logger.error(
+                "serving: device tick exceeded FLEETX_SERVING_TICK_TIMEOUT_S"
+                "=%.3fs; diagnostics banked in engine.hang_diagnostics, "
+                "abandoning the call and recovering", self.tick_timeout_s)
+            raise TickTimeout(
+                f"device tick exceeded {self.tick_timeout_s}s "
+                "(hung device step; see engine.hang_diagnostics)") from None
+
+    # ----------------------------------------------------- graceful drain
+
+    def request_shutdown(self, grace_s: Optional[float] = None) -> None:
+        """Flip the engine into draining mode: new submits reject with
+        :class:`ShuttingDown`, ticking continues so in-flight and queued
+        requests finish, and once ``grace_s`` (default
+        ``FLEETX_SERVING_GRACE_S``) elapses the remainder is retired with
+        partial tokens. Idempotent and async-signal-safe (flag writes
+        only) — exactly what a SIGTERM handler may do."""
+        if self._shutting_down:
+            return
+        self._shutting_down = True
+        grace = self.grace_s if grace_s is None else float(grace_s)
+        self._shutdown_deadline = self._now() + max(grace, 0.0)
+        logger.warning(
+            "serving: shutdown requested — admission stopped, draining %d "
+            "active + %d queued request(s) under a %.1fs grace window",
+            len(self._active), self.scheduler.queue_depth, max(grace, 0.0))
+
+    def shutdown(self, grace_s: Optional[float] = None
+                 ) -> Dict[int, ServingResult]:
+        """Graceful drain to completion: :meth:`request_shutdown`, tick
+        until every request finished or the grace window closed (then
+        retire the rest with ``finish_reason="shutdown"`` and partial
+        tokens), and return-and-clear ALL results — every request that was
+        in flight or queued gets a terminal result. The checkpoint-safe
+        shutdown seam the multi-replica router drains replicas through."""
+        self.request_shutdown(grace_s)
+        while len(self.scheduler) or self._active:
+            self.step()  # the deadline check inside step() retires leftovers
+        out, self._results = self._results, {}
+        return out
+
+    def _retire_all(self, reason: str):
+        """Retire every queued and in-flight request right now (grace
+        window closed): queued requests return empty, in-flight ones their
+        partial tokens."""
+        now = self._now()
+        retired = []
+        for req in self.scheduler.drain_all():
+            self._finalize(req, reason, now)
+            retired.append(req.id)
+        for req in list(self._active.values()):
+            self._evict(req, reason, now)
+            retired.append(req.id)
+        return retired
+
+    def install_sigterm_handler(self, grace_s: Optional[float] = None):
+        """Register a SIGTERM handler that calls :meth:`request_shutdown`
+        (flags only — the drain itself happens in whatever step()/drain()
+        loop is already running, never inside the signal context) and then
+        chains any previously-installed handler, mirroring the Trainer's
+        preemption plumbing (core/engine.py). Main thread only, per the
+        ``signal`` module's rules. Returns the previous handler."""
+        import signal
+
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def on_sigterm(signum, frame):
+            self.request_shutdown(grace_s)
+            if callable(prev) and prev not in (signal.SIG_IGN,
+                                               signal.SIG_DFL):
+                prev(signum, frame)
+
+        self._prev_sigterm = prev
+        signal.signal(signal.SIGTERM, on_sigterm)
+        return prev
+
+    def uninstall_sigterm_handler(self) -> None:
+        """Put back whatever SIGTERM handler install displaced."""
+        import signal
+
+        if self._prev_sigterm is not None:
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+            self._prev_sigterm = None
 
     def drain(self, max_ticks: Optional[int] = None) -> Dict[int, ServingResult]:
         """Tick until queue and slots are empty (or ``max_ticks``), then
@@ -520,14 +1007,16 @@ class ServingEngine:
             "rng": jnp.zeros((s, 2), jnp.uint32),
         }
 
-    def _admit_fn(self, st, slot, tok, length, active, eos, max_new, min_new,
-                  greedy, temperature, top_k, top_p, key):
-        """Jitted: install one admitted request's scalars into slot
-        ``slot`` of the device state (first token already sampled)."""
+    def _admit_fn(self, st, slot, tok, length, decoded, active, eos, max_new,
+                  min_new, greedy, temperature, top_k, top_p, key):
+        """Jitted: install one request's scalars into slot ``slot`` of the
+        device state — ``decoded=1`` for a fresh admission (first token
+        just sampled), ``decoded=n`` when replay recovery reinstalls a
+        request that already emitted ``n`` tokens."""
         return {
             "last_tok": st["last_tok"].at[slot].set(tok),
             "lengths": st["lengths"].at[slot].set(length),
-            "decoded": st["decoded"].at[slot].set(1),
+            "decoded": st["decoded"].at[slot].set(decoded),
             "active": st["active"].at[slot].set(active),
             "eos": st["eos"].at[slot].set(eos),
             "max_new": st["max_new"].at[slot].set(max_new),
@@ -625,34 +1114,90 @@ class ServingEngine:
         return jax.jit(
             prefill, donate_argnums=(1,) if self._donate_cache else ())
 
+    def _prefill_scalars(self, req: Request, replay: bool, step_key):
+        """Per-request sampler scalars for a prefill call. Replay rebuilds
+        K/V only: greedy argmax with inert filters (result discarded, no
+        stream consumed)."""
+        if replay:
+            return (jnp.asarray(-1, jnp.int32), jnp.asarray(0, jnp.int32),
+                    jnp.asarray(True), jnp.asarray(1.0, jnp.float32),
+                    jnp.asarray(0, jnp.int32), jnp.asarray(1.0, jnp.float32),
+                    req.rng_key)
+        return (jnp.asarray(req.eos_token_id, jnp.int32),
+                jnp.asarray(req.min_new_tokens, jnp.int32),
+                jnp.asarray(req.greedy),
+                jnp.asarray(req.temperature, jnp.float32),
+                jnp.asarray(req.top_k, jnp.int32),
+                jnp.asarray(req.top_p, jnp.float32),
+                step_key)
+
+    def _guarded_prefill(self, req: Request, fn, args):
+        """One prefill device call through the fault-injection hook;
+        stores the returned cache. Deliberately NOT under the hung-tick
+        watchdog: prefill calls legitimately include fresh-bucket XLA
+        compiles (seconds), and replay recovery re-prefills through here —
+        a watchdog here would misread every cold compile as a hang and
+        quarantine healthy requests. The watchdog budget is calibrated for
+        the steady-state decode tick, the loop that actually wedges."""
+        attempt = self._fault_prefills
+        self._fault_prefills += 1
+        faults.on_serving_prefill(attempt, req.id)
+        cache, tok = fn(*args)
+        self.cache_manager.cache = cache
+        return tok
+
+    def _slot_prefill_call(self, req: Request, tokens, slot,
+                           replay: bool = False):
+        """Batch-1 prefill of ``tokens`` scattered into ``slot``'s cache
+        row. Admission returns ``(first_token, carry_key)``; replay
+        (``tokens`` = the request's history) returns None."""
+        bucket = -(-len(tokens) // self.prefill_bucket) * self.prefill_bucket
+        bucket = min(max(bucket, len(tokens)), self.cache_len)
+        fn = self._prefill_jits.get(bucket)
+        if fn is None:
+            fn = self._prefill_jits[bucket] = self._make_prefill(bucket)
+        padded = np.zeros(bucket, np.int32)
+        padded[:len(tokens)] = tokens
+        step_key = carry_key = None
+        if not replay:
+            step_key, carry_key = jax.random.split(req.rng_key)
+        args = (self.params, self.cache_manager.cache, jnp.asarray(padded),
+                jnp.asarray(len(tokens), jnp.int32),
+                jnp.asarray(slot, jnp.int32),
+                *self._prefill_scalars(req, replay, step_key))
+        tok = self._guarded_prefill(req, fn, args)
+        return None if replay else (tok, carry_key)
+
+    def _paged_prefill_call(self, req: Request, suffix, shared, lane,
+                            replay: bool = False):
+        """Batch-1 prefill of the non-shared ``suffix`` straight into
+        ``lane``'s pages at absolute positions ``shared..``. Admission
+        returns ``(first_token, carry_key)``; replay returns None."""
+        bucket = -(-len(suffix) // self.prefill_bucket) * self.prefill_bucket
+        bucket = min(max(bucket, len(suffix)), self.cache_len - shared)
+        fn = self._prefill_jits.get(bucket)
+        if fn is None:
+            fn = self._prefill_jits[bucket] = self._make_paged_prefill(bucket)
+        padded = np.zeros(bucket, np.int32)
+        padded[:len(suffix)] = suffix
+        step_key = carry_key = None
+        if not replay:
+            step_key, carry_key = jax.random.split(req.rng_key)
+        args = (self.params, self.cache_manager.cache, jnp.asarray(padded),
+                jnp.asarray(len(suffix), jnp.int32),
+                jnp.asarray(shared, jnp.int32),
+                jnp.asarray(self.cache_manager.tables[lane]),
+                *self._prefill_scalars(req, replay, step_key))
+        tok = self._guarded_prefill(req, fn, args)
+        return None if replay else (tok, carry_key)
+
     def _slot_prefill(self, req: Request):
         """Slot-path admission storage: claim a slot, prefill the WHOLE
         prompt batch-1 into a fresh cache and scatter it into the slot's
         row. Returns ``(first_token, carry_key)``; sets ``req.slot``."""
         slot = self.cache_manager.alloc(req.id, req.prompt_len)
         req.slot = slot
-        bucket = -(-req.prompt_len // self.prefill_bucket) * self.prefill_bucket
-        bucket = min(max(bucket, req.prompt_len), self.cache_len)
-        fn = self._prefill_jits.get(bucket)
-        if fn is None:
-            fn = self._prefill_jits[bucket] = self._make_prefill(bucket)
-        padded = np.zeros(bucket, np.int32)
-        padded[:req.prompt_len] = req.prompt
-        step_key, carry_key = jax.random.split(req.rng_key)
-        cache, tok = fn(
-            self.params, self.cache_manager.cache, jnp.asarray(padded),
-            jnp.asarray(req.prompt_len, jnp.int32),
-            jnp.asarray(slot, jnp.int32),
-            jnp.asarray(req.eos_token_id, jnp.int32),
-            jnp.asarray(req.min_new_tokens, jnp.int32),
-            jnp.asarray(req.greedy),
-            jnp.asarray(req.temperature, jnp.float32),
-            jnp.asarray(req.top_k, jnp.int32),
-            jnp.asarray(req.top_p, jnp.float32),
-            step_key,
-        )
-        self.cache_manager.cache = cache
-        return tok, carry_key
+        return self._slot_prefill_call(req, req.prompt, slot)
 
     def _paged_prefill(self, req: Request):
         """Paged-path admission storage: claim a lane + page chain (trie-
@@ -668,29 +1213,8 @@ class ServingEngine:
                 f"{self.cache_manager.pool.free_pages} pages free)")
         lane, shared = alloc
         req.slot = lane
-        suffix = req.prompt[shared:]
-        bucket = -(-len(suffix) // self.prefill_bucket) * self.prefill_bucket
-        bucket = min(max(bucket, len(suffix)), self.cache_len - shared)
-        fn = self._prefill_jits.get(bucket)
-        if fn is None:
-            fn = self._prefill_jits[bucket] = self._make_paged_prefill(bucket)
-        padded = np.zeros(bucket, np.int32)
-        padded[:len(suffix)] = suffix
-        step_key, carry_key = jax.random.split(req.rng_key)
-        cache, tok = fn(
-            self.params, self.cache_manager.cache, jnp.asarray(padded),
-            jnp.asarray(len(suffix), jnp.int32),
-            jnp.asarray(shared, jnp.int32),
-            jnp.asarray(self.cache_manager.tables[lane]),
-            jnp.asarray(req.eos_token_id, jnp.int32),
-            jnp.asarray(req.min_new_tokens, jnp.int32),
-            jnp.asarray(req.greedy),
-            jnp.asarray(req.temperature, jnp.float32),
-            jnp.asarray(req.top_k, jnp.int32),
-            jnp.asarray(req.top_p, jnp.float32),
-            step_key,
-        )
-        self.cache_manager.cache = cache
+        tok, carry_key = self._paged_prefill_call(
+            req, req.prompt[shared:], shared, lane)
         self.cache_manager.register_prefix(lane, req.prompt)
         pool = self.cache_manager.pool
         self.metrics.record_prefix(
@@ -698,24 +1222,16 @@ class ServingEngine:
             int(pool.alloc_counts[lane] - pool.shared_counts[lane]))
         return tok, carry_key
 
-    def _admit(self, req: Request) -> None:
-        tok, carry_key = (self._paged_prefill(req) if self.paged
-                          else self._slot_prefill(req))
-        slot = req.slot
-        tok = int(tok)  # host sync: the first token is now observable
-        now = self._now()
-        req.admit_time = req.first_token_time = now
-        req.tokens.append(tok)
-        self.metrics.record_admit(now - req.submit_time)
-        self.metrics.record_first_token(now - req.submit_time)
-        self.metrics.record_tokens(1)
-        done_eos = req.eos_token_id >= 0 and tok == req.eos_token_id
-        done = done_eos or req.max_new_tokens <= 1
+    def _install_lane(self, req: Request, *, tok: int, length: int,
+                      decoded: int, active: bool, carry_key) -> None:
+        """Install one request's decode-lane scalars into the device
+        state (shared by fresh admission and replay recovery)."""
         self._state = self._admit_jit(
-            self._state, jnp.asarray(slot, jnp.int32),
+            self._state, jnp.asarray(req.slot, jnp.int32),
             jnp.asarray(tok, jnp.int32),
-            jnp.asarray(req.prompt_len, jnp.int32),
-            jnp.asarray(not done),
+            jnp.asarray(length, jnp.int32),
+            jnp.asarray(decoded, jnp.int32),
+            jnp.asarray(active),
             jnp.asarray(req.eos_token_id, jnp.int32),
             jnp.asarray(req.max_new_tokens, jnp.int32),
             jnp.asarray(req.min_new_tokens, jnp.int32),
@@ -725,6 +1241,24 @@ class ServingEngine:
             jnp.asarray(req.top_p, jnp.float32),
             carry_key,
         )
+
+    def _admit(self, req: Request) -> None:
+        self._fault_ctx = ("prefill", req.id)
+        tok, carry_key = (self._paged_prefill(req) if self.paged
+                          else self._slot_prefill(req))
+        self._fault_ctx = None
+        self._prefill_strikes.pop(req.id, None)  # survived its prefill
+        tok = int(tok)  # host sync: the first token is now observable
+        now = self._now()
+        req.admit_time = req.first_token_time = now
+        req.tokens.append(tok)
+        self.metrics.record_admit(now - req.submit_time)
+        self.metrics.record_first_token(now - req.submit_time)
+        self.metrics.record_tokens(1)
+        done_eos = req.eos_token_id >= 0 and tok == req.eos_token_id
+        done = done_eos or req.max_new_tokens <= 1
+        self._install_lane(req, tok=tok, length=req.prompt_len, decoded=1,
+                           active=not done, carry_key=carry_key)
         # callback AFTER the device state is consistent: a raising callback
         # then retires exactly this request and can't leave the slot half-
         # installed (previously it unwound _admit between cache scatter and
@@ -734,7 +1268,7 @@ class ServingEngine:
         elif done:
             self._finalize(req, "eos" if done_eos else "max_length", now)
         else:
-            self._active[slot] = req
+            self._active[req.slot] = req
 
     def _decode_fn(self, params, cache, st, tables, all_greedy: bool):
         """Jitted: ONE decode token for every slot (inactive slots ride
@@ -799,9 +1333,32 @@ class ServingEngine:
             if not self._active:
                 return retired
         all_greedy = all(r.greedy for r in self._active.values())
-        cache, st, tok, done = self._decode_jit(
-            self.params, self.cache_manager.cache, self._state,
-            self._device_tables(), all_greedy)
+        active_ids = [r.id for r in self._active.values()]
+        attempt = self._fault_ticks
+        self._fault_ticks += 1
+        # bind the device operands NOW, on the main thread: if the watchdog
+        # abandons this call mid-hang and recovery swaps self.cache_manager/
+        # self._state, the zombie thread must wake holding the OLD buffers
+        # (safe to donate — they are dead) and never touch the recovered
+        # ones; _device_tables() also mutates engine state, so it cannot run
+        # on the worker thread
+        cache_in, state_in = self.cache_manager.cache, self._state
+        tables_in = self._device_tables()
+
+        def run():
+            # fault hooks INSIDE the guarded call: an injected hang is what
+            # the watchdog times, an injected raise unwinds like a real
+            # device error (both inert one-flag checks in production)
+            faults.on_serving_tick(attempt)
+            faults.on_serving_batch(active_ids)
+            out = self._decode_jit(self.params, cache_in, state_in,
+                                   tables_in, all_greedy)
+            if self.tick_timeout_s > 0:
+                # surface async device errors inside the watchdog window
+                jax.block_until_ready(out)
+            return out
+
+        cache, st, tok, done = self._run_device(run)
         self.cache_manager.cache = cache
         self._state = st
         tok_np = np.asarray(tok)  # host sync per tick

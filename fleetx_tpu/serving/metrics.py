@@ -15,6 +15,7 @@ before reading latencies as steady-state.
 
 from __future__ import annotations
 
+import collections
 import time
 from typing import Dict, List, Optional
 
@@ -63,6 +64,20 @@ class ServingMetrics:
         self._page_occupancy_sum = 0.0
         self._page_occupancy_peak = 0.0
         self._page_ticks = 0
+        # crash-safety counters (docs/RESILIENCE.md serving-recovery):
+        # recoveries = replay-recovery passes the engine ran, poison =
+        # requests quarantined by bisection/replay, drain_rejects = submits
+        # refused because the engine was shutting down. Tick wall-clock
+        # samples make the recovery cost observable (a recovery tick re-
+        # prefills every active request, so its duration spikes).
+        self.engine_recoveries = 0
+        self.poison_retired = 0
+        self.drain_rejects = 0
+        # bounded window: one sample per tick forever would grow without
+        # limit on a continuously-ticking replica (and np.percentile over
+        # it would too); 4096 ticks ≈ the recent-behavior window the
+        # percentiles are meant to describe
+        self.tick_s = collections.deque(maxlen=4096)
 
     def record_submit(self) -> None:
         """A request entered the admission queue."""
@@ -88,6 +103,20 @@ class ServingMetrics:
     def record_reject(self) -> None:
         """A submit was refused by admission control (queue full)."""
         self.rejected += 1
+
+    def record_recovery(self) -> None:
+        """The engine ran one replay-recovery pass (device state rebuilt
+        and every active request re-prefilled from its host history)."""
+        self.engine_recoveries += 1
+
+    def record_poison(self) -> None:
+        """A poison request was quarantined (bisection or replay failure)
+        and retired with ``finish_reason="error"``."""
+        self.poison_retired += 1
+
+    def record_drain_reject(self) -> None:
+        """A submit was refused because the engine is shutting down."""
+        self.drain_rejects += 1
 
     def record_prefix(self, shared_tokens: int, prompt_tokens: int,
                       pages: int) -> None:
@@ -135,14 +164,19 @@ class ServingMetrics:
         """Requests retired because their ``on_token`` callback raised."""
         return self.finish_reasons.get("error", 0)
 
-    def observe_tick(self, queue_depth: int, active_slots: int) -> None:
-        """Per-tick gauge sample from the engine's scheduler loop."""
+    def observe_tick(self, queue_depth: int, active_slots: int,
+                     tick_s: Optional[float] = None) -> None:
+        """Per-tick gauge sample from the engine's scheduler loop;
+        ``tick_s`` is the tick's wall-clock (feeds the p50/p99 that make
+        recovery/quarantine cost visible next to steady-state ticks)."""
         self.ticks += 1
         self.queue_depth = queue_depth
         self.active_slots = active_slots
         self._queue_depth_sum += queue_depth
         self._queue_depth_peak = max(self._queue_depth_peak, queue_depth)
         self._occupancy_sum += active_slots
+        if tick_s is not None:
+            self.tick_s.append(float(tick_s))
 
     def snapshot(self) -> Dict:
         """Aggregate view: counters, queue/occupancy stats, TTFT
@@ -200,6 +234,15 @@ class ServingMetrics:
                                     / self._page_ticks
                                     if self._page_ticks else 0.0),
             "page_occupancy_peak": self._page_occupancy_peak,
+            # crash-safety story: how often the engine recovered, what it
+            # quarantined, what shutdown turned away, and what a tick costs
+            "engine_recoveries": self.engine_recoveries,
+            "poison_retired": self.poison_retired,
+            "drain_rejects": self.drain_rejects,
+            "tick_ms_p50": (None if not self.tick_s
+                            else _pct(self.tick_s, 50) * 1e3),
+            "tick_ms_p99": (None if not self.tick_s
+                            else _pct(self.tick_s, 99) * 1e3),
         }
 
     def log_snapshot(self) -> None:

@@ -88,6 +88,26 @@ class FIFOScheduler:
                 return r
         return None
 
+    def snapshot(self) -> tuple:
+        """Immutable view of the queue for the engine's transactional tick
+        (crash-safe serving, docs/RESILIENCE.md): captured before device
+        work, handed back to :meth:`restore` if the tick fails. Replacement
+        schedulers must implement both so a rolled-back tick restores THEIR
+        internal order too."""
+        return tuple(self._queue)
+
+    def restore(self, snap: tuple) -> None:
+        """Reinstate a queue captured by :meth:`snapshot` (the requests
+        themselves are restored field-by-field by the engine)."""
+        self._queue = collections.deque(snap)
+
+    def drain_all(self) -> List[Request]:
+        """Remove and return every queued request (graceful-drain deadline:
+        whatever never won a slot is retired with empty tokens)."""
+        out = list(self._queue)
+        self._queue.clear()
+        return out
+
     def pop_expired(self, now: float) -> List[Request]:
         """Remove and return every queued request whose queue-TTL or total
         deadline has passed at ``now``. Arrival order is preserved for the

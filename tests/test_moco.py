@@ -248,7 +248,10 @@ def test_moco_lincls_reads_trainer_checkpoint_layout(tmp_path):
     mgr = ocp.CheckpointManager(str(ckdir))
     mgr.save(3, args=ocp.args.Composite(
         state=ocp.args.StandardSave(
-            {"step": np.int32(3), "params": dict(variables["params"])}),
+            # 0-d ndarray, not a numpy scalar: StandardSave rejects bare
+            # np.int32 — the real Trainer state's step is an array too
+            {"step": np.asarray(3, np.int32),
+             "params": dict(variables["params"])}),
         meta=ocp.args.JsonSave({"epoch": 0, "consumed_samples": 0}),
     ))
     mgr.wait_until_finished()

@@ -87,9 +87,9 @@ def test_bench_serving_records_schema(monkeypatch):
     recs = bs.serving_records(n_requests=6, slots=2)
     assert [r["metric"] for r in recs] == [
         "gpt_345m_serving_static", "gpt_345m_serving_continuous",
-        "gpt_345m_serving_shared_prefix",
+        "gpt_345m_serving_shared_prefix", "gpt_345m_serving_faulted",
     ]
-    static, cont, shared = recs
+    static, cont, shared, faulted = recs
     for r in recs:
         assert r["unit"] == "tokens/s"
         assert np.isfinite(r["value"]) and r["value"] > 0
@@ -113,6 +113,14 @@ def test_bench_serving_records_schema(monkeypatch):
     assert d["prefix_hit_rate"] == 1.0
     assert d["prefill_tokens_saved"] > 0
     assert 0 < d["page_occupancy_peak"] <= 1
+    # the faulted run priced exactly one recovery, lost no bytes, and
+    # surfaced the crash-safety observability fields
+    d = faulted["detail"]
+    assert d["parity"] is True
+    assert d["engine_recoveries"] == 1
+    assert d["poison_retired"] == 0
+    assert 0 <= d["recovery_overhead_frac"] < 1
+    assert d["tick_ms_p50"] > 0 and d["tick_ms_p99"] >= d["tick_ms_p50"]
 
 
 @pytest.mark.slow  # 9.8s on the slow-host baseline (PR 7 tier-1 budget audit)
@@ -132,6 +140,22 @@ def test_chaos_check_unknown_scenario_fails(tmp_path):
     import tools.chaos_check as cc
 
     assert cc.main(["--only", "nope", "--workdir", str(tmp_path)]) == 1
+
+
+def test_chaos_check_serving_recovery_scenarios(tmp_path, capsys):
+    """The serving crash-safety scenarios (recovery, poison quarantine,
+    hung-tick watchdog, graceful drain) pass through the CLI driver and
+    print one PASS line each — the acceptance-gate demonstration outside
+    pytest (the full suite is tests/test_serving_recovery.py)."""
+    sys.path.insert(0, REPO)
+    import tools.chaos_check as cc
+
+    names = "serving_recovery,serving_poison,serving_hang,serving_drain"
+    rc = cc.main(["--only", names, "--workdir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    for name in names.split(","):
+        assert f"PASS {name}" in out
 
 
 def test_precomputed_embeddings_feed_text_image_dataset(tmp_path):

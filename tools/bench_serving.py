@@ -23,6 +23,13 @@ timed pass hits it — reporting prefix hit rate, prefill tokens saved,
 page occupancy, and fresh pages/request next to the usual TTFT and
 tokens/s (byte parity between cold-trie and warm-trie passes asserted).
 
+A fourth record (`faulted`) prices the crash-safety machinery: the same
+continuous workload with a decode-tick failure injected mid-run, so the
+engine rolls the tick back and replay-recovers (docs/RESILIENCE.md). It
+reports tokens/s next to the clean run (`recovery_overhead_frac`), the
+recovery count, and tick p50/p99 — resilience cost in the perf
+trajectory, with byte parity vs the clean run asserted.
+
 Standalone:  python tools/bench_serving.py
 In-process:  from tools.bench_serving import serving_records
 """
@@ -253,6 +260,43 @@ def serving_records(n_requests: int = N_REQUESTS, slots: int = SLOTS):
     )
     cont_detail["parity"] = parity
 
+    # faulted mode: same workload, one injected decode-tick failure ->
+    # transactional rollback + replay recovery mid-run; the delta vs the
+    # clean continuous record IS the price of a recovery
+    from fleetx_tpu.resilience.faults import faults
+
+    faulted_engine = ServingEngine(model, variables, slots=slots,
+                                   cache_len=model.cfg.max_position_embeddings,
+                                   gen_cfg=gen_cfg,
+                                   prefill_bucket=8 if _TINY else 32)
+    _run_continuous(faulted_engine, workload)  # compile warmup
+    # fail a tick mid-run: the workload takes >= useful/slots decode ticks,
+    # so 1/4 of that is comfortably inside the timed pass
+    fault_tick = faulted_engine._fault_ticks + max(
+        sum(g for _, g in workload) // slots // 4, 1)
+    faults.configure(tick_raise=str(fault_tick))
+    try:
+        fault_toks, _, fault_detail = _run_continuous(faulted_engine, workload)
+    finally:
+        faults.reset()
+    snap = faulted_engine.metrics.snapshot()
+    assert snap["engine_recoveries"] == 1, (
+        f"faulted bench expected exactly 1 recovery, got "
+        f"{snap['engine_recoveries']}")
+    # the recovery must not cost a single byte of output
+    fault_detail["parity"] = all(
+        np.array_equal(a, b) for a, b in zip(cont_toks, fault_toks))
+    fault_detail["engine_recoveries"] = snap["engine_recoveries"]
+    fault_detail["poison_retired"] = snap["poison_retired"]
+    fault_detail["tick_ms_p50"] = (None if snap["tick_ms_p50"] is None
+                                   else round(snap["tick_ms_p50"], 2))
+    fault_detail["tick_ms_p99"] = (None if snap["tick_ms_p99"] is None
+                                   else round(snap["tick_ms_p99"], 2))
+    clean_tps = cont_detail["useful_tokens"] / cont_detail["elapsed_s"]
+    fault_tps = fault_detail["useful_tokens"] / fault_detail["elapsed_s"]
+    fault_detail["recovery_overhead_frac"] = round(
+        max(1.0 - fault_tps / clean_tps, 0.0), 3)
+
     # shared-prefix mode: paged engine, trie-cold warmup then warm timing
     sp_workload = _shared_prefix_workload(n_requests)
     sp_engine = ServingEngine(model, variables, slots=slots,
@@ -274,7 +318,8 @@ def serving_records(n_requests: int = N_REQUESTS, slots: int = SLOTS):
     records = []
     for mode, detail in (("static", static_detail),
                          ("continuous", cont_detail),
-                         ("shared_prefix", sp_detail)):
+                         ("shared_prefix", sp_detail),
+                         ("faulted", fault_detail)):
         detail["device"] = device
         records.append({
             "metric": f"gpt_345m_serving_{mode}",

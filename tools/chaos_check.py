@@ -10,7 +10,16 @@ per scenario, non-zero exit on any failure:
 - ``serving``: a bounded queue rejects, a queue-TTL expires to
   ``finish_reason="timeout"``, ``cancel()`` frees the slot, and a
   raising ``on_token`` callback retires only its own request while a
-  clean request keeps one-shot parity.
+  clean request keeps one-shot parity;
+- ``serving_recovery``: an injected decode-tick failure rolls the tick
+  back and replay recovery resumes byte-identically (slot AND paged
+  paths, PagePool invariants checked);
+- ``serving_poison``: a poison request is isolated by bisection and
+  quarantined with partial tokens while neighbors keep byte parity;
+- ``serving_hang``: a hung tick trips the FLEETX_SERVING_TICK_TIMEOUT_S
+  watchdog, diagnostics are banked, recovery keeps parity;
+- ``serving_drain``: shutdown() under load returns EVERY request with a
+  terminal finish_reason (partials kept) and rejects new submits.
 
 Usage::
 
@@ -233,10 +242,157 @@ def scenario_serving(tmp):
             f"cancels={m.cancels} callback_errors={m.callback_errors})")
 
 
+def _serving_fixture():
+    """Tiny GPT + engine factory + mixed-length workload shared by the
+    serving-recovery scenarios."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fleetx_tpu.models.gpt.generation import GenerationConfig
+    from fleetx_tpu.models.gpt.model import GPTConfig, GPTForPretraining
+    from fleetx_tpu.serving import ServingEngine
+
+    cfg = GPTConfig(
+        vocab_size=61, hidden_size=32, num_layers=1, num_attention_heads=2,
+        ffn_hidden_size=64, max_position_embeddings=64,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        dtype=jnp.float32, use_flash_attention=False)
+    gen_cfg = GenerationConfig(decode_strategy="greedy", eos_token_id=10**6,
+                               pad_token_id=60, max_length=8)
+    model = GPTForPretraining(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))
+    prompts = [np.asarray([1, 2, 3], np.int32),
+               np.asarray([4, 5, 6, 7, 8], np.int32),
+               np.asarray([9, 10], np.int32),
+               np.asarray([11, 12, 13], np.int32)]
+
+    def make(paged, **kw):
+        return ServingEngine(model, params, slots=3, cache_len=32,
+                             gen_cfg=gen_cfg, prefill_bucket=4, paged=paged,
+                             page_size=8 if paged else None, **kw)
+
+    return make, prompts
+
+
+def _run_workload(eng, prompts, max_length=8):
+    import numpy as np
+
+    rids = [eng.submit(p, max_length=max_length) for p in prompts]
+    res = eng.drain()
+    return [np.asarray(res[r].tokens) for r in rids], res, rids
+
+
+def scenario_serving_recovery(tmp):
+    """Tick-raise -> rollback + replay recovery, byte parity both paths."""
+    import numpy as np
+
+    from fleetx_tpu.resilience.faults import faults
+
+    make, prompts = _serving_fixture()
+    recov = []
+    for paged in (False, True):
+        clean, _, _ = _run_workload(make(paged), prompts)
+        faults.configure(tick_raise="1")
+        try:
+            eng = make(paged)
+            faulty, _, _ = _run_workload(eng, prompts)
+        finally:
+            faults.reset()
+        assert eng.metrics.engine_recoveries == 1, eng.metrics.snapshot()
+        assert all(np.array_equal(a, b) for a, b in zip(clean, faulty)), \
+            f"paged={paged} tokens diverged after recovery"
+        if paged:
+            eng.cache_manager.pool.check_invariants()
+        recov.append(eng.metrics.engine_recoveries)
+    return ("tick-raise recovered byte-identically on slot AND paged paths "
+            f"(engine_recoveries={recov})")
+
+
+def scenario_serving_poison(tmp):
+    """Poison request bisected out; neighbors byte-identical."""
+    import numpy as np
+
+    from fleetx_tpu.resilience.faults import faults
+
+    make, prompts = _serving_fixture()
+    clean, _, _ = _run_workload(make(True), prompts)
+    faults.configure(poison_request="1")
+    try:
+        eng = make(True)
+        _, res, rids = _run_workload(eng, prompts)
+    finally:
+        faults.reset()
+    assert res[rids[1]].finish_reason == "error", res[rids[1]]
+    assert len(res[rids[1]].tokens) >= 1, "partial tokens lost"
+    for i in (0, 2, 3):
+        assert np.array_equal(np.asarray(res[rids[i]].tokens), clean[i]), \
+            f"neighbor {i} disturbed by quarantine"
+    eng.cache_manager.pool.check_invariants()
+    m = eng.metrics
+    assert m.poison_retired == 1, m.snapshot()
+    return (f"poison request quarantined with partial tokens after "
+            f"{m.engine_recoveries} recoveries; 3 neighbors byte-identical")
+
+
+def scenario_serving_hang(tmp):
+    """Hung tick -> watchdog timeout -> recovery, parity held."""
+    import numpy as np
+
+    from fleetx_tpu.resilience.faults import faults
+
+    make, prompts = _serving_fixture()
+    clean, _, _ = _run_workload(make(True), prompts)
+    eng = make(True)
+    eng.submit(np.asarray([50, 51], np.int32), max_length=3)
+    eng.drain()  # warm the decode jit: the budget is for steady-state ticks
+    faults.configure(tick_hang=str(eng._fault_ticks + 1), tick_hang_s=2.0)
+    try:
+        eng.tick_timeout_s = 0.3
+        faulty, _, _ = _run_workload(eng, prompts)
+    finally:
+        faults.reset()
+    assert eng.hang_diagnostics is not None, "diagnostics not banked"
+    assert eng.metrics.engine_recoveries >= 1
+    assert all(np.array_equal(a, b) for a, b in zip(clean, faulty))
+    return ("hung tick abandoned at 0.3s, diagnostics banked, recovery "
+            "kept byte parity")
+
+
+def scenario_serving_drain(tmp):
+    """shutdown() under load: every request returns, partials kept."""
+    import numpy as np
+
+    from fleetx_tpu.serving import ShuttingDown
+
+    make, prompts = _serving_fixture()
+    eng = make(True)
+    rids = [eng.submit(p, max_length=50) for p in prompts]
+    eng.step()
+    eng.step()
+    res = eng.shutdown(grace_s=0.0)
+    assert set(res) == set(rids), "a request vanished in shutdown"
+    assert all(res[r].finish_reason == "shutdown" for r in rids)
+    partials = sum(1 for r in rids if len(res[r].tokens))
+    assert partials >= 3, "partial tokens lost in drain"
+    try:
+        eng.submit(prompts[0])
+        raise AssertionError("draining engine accepted a submit")
+    except ShuttingDown:
+        pass
+    assert eng.metrics.drain_rejects == 1
+    return (f"shutdown returned {len(res)}/{len(rids)} requests "
+            f"({partials} with partial tokens); admission rejected")
+
+
 SCENARIOS = {
     "sentry": scenario_sentry,
     "ckpt": scenario_ckpt,
     "serving": scenario_serving,
+    "serving_recovery": scenario_serving_recovery,
+    "serving_poison": scenario_serving_poison,
+    "serving_hang": scenario_serving_hang,
+    "serving_drain": scenario_serving_drain,
 }
 
 
