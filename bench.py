@@ -26,29 +26,6 @@ import numpy as np
 
 BASELINE_TOKENS_PER_SEC = 16260.0  # A100-40G, reference single_card.md
 
-# Peak dense bf16 FLOP/s per chip by device kind (public spec sheets).
-_PEAK_FLOPS = {
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,        # v5p
-    "TPU v5p": 459e12,
-    "TPU v4": 275e12,
-    "TPU v4 lite": 138e12,   # v4i
-    "TPU v3": 123e12,
-    "TPU v6 lite": 918e12,   # Trillium
-    "TPU v6e": 918e12,
-    "cpu": 1e12,             # placeholder so CPU smoke runs don't div0
-}
-
-
-def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "cpu")
-    # longest-prefix match so 'TPU v4 lite' resolves before 'TPU v4'
-    for name in sorted(_PEAK_FLOPS, key=len, reverse=True):
-        if kind.startswith(name):
-            return _PEAK_FLOPS[name]
-    return 197e12  # unknown accelerator: assume v5e-class
-
 
 def model_flops_per_token(n_params: int, num_layers: int, seq: int, hidden: int) -> float:
     """MODEL-FLOPs accounting: what the math requires, not what the chip
@@ -84,6 +61,7 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
     from fleetx_tpu.core.engine import Trainer
     from fleetx_tpu.models import build_module
     from fleetx_tpu.utils.config import AttrDict, process_configs
+    from fleetx_tpu.utils.hw import peak_flops_per_chip
     import fleetx_tpu.parallel.env as dist_env
 
     cfg = AttrDict(
@@ -189,7 +167,7 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
         n_params, cfg.Model.num_layers, seq, cfg.Model.hidden_size
     )
     achieved_flops = tokens_per_sec * flops_per_token
-    peak = _peak_flops(jax.devices()[0]) * n_chips
+    peak = peak_flops_per_chip(jax.devices()[0]) * n_chips
     mfu = achieved_flops / peak
     rec = {
         "metric": "gpt_345m_pretrain_throughput",
@@ -213,6 +191,19 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
             "baseline": "A100-40G 16260 tokens/s (reference single_card.md)",
         },
     }
+    # feed the obs layer this record's numbers (gauges are last-writer-
+    # wins; the process-cumulative registry snapshot is embedded ONCE per
+    # bench invocation, in main(), so no record carries another record's
+    # blended histograms); xla_mfu is the cost_analysis-flops MFU the
+    # live TRAIN line reports — remat recompute included, unlike the
+    # model-flops `mfu` above, so the two bracket the true utilization
+    trainer._obs_step_time.observe(dt / steps)
+    trainer._obs_tokens_per_s.set(tokens_per_sec)
+    trainer._obs_loss.set(final_loss)
+    xla_mfu = trainer._step_mfu(dt / steps)
+    if xla_mfu is not None:
+        trainer._obs_mfu.set(xla_mfu)
+        rec["detail"]["xla_mfu"] = round(xla_mfu, 4)
     # release the model/opt state before the next in-process bench run
     del state, trainer, module, db
     gc.collect()
@@ -350,6 +341,18 @@ def main():
             "so the perf trajectory has no silent gap (BENCH_CPU_FALLBACK)")
     if extras:
         anchor["detail"]["extra_records"] = extras
+    # full metric context for the perf trajectory (docs/OBSERVABILITY.md):
+    # the registry/event snapshot is PROCESS-CUMULATIVE over everything
+    # this bench invocation ran (anchor + in-process extras), embedded
+    # once here rather than per record so no record misattributes another
+    # record's histogram samples as its own
+    from fleetx_tpu.obs import get_event_log, get_registry
+
+    anchor["detail"]["obs"] = {
+        "scope": "process-cumulative (anchor + in-process extra records)",
+        "metrics": get_registry().snapshot(),
+        "events": get_event_log().counts(),
+    }
     print(json.dumps(anchor))
 
 

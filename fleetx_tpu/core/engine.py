@@ -27,12 +27,17 @@ from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fleetx_tpu.models.module import BasicModule
+from fleetx_tpu.obs import http as obs_http
+from fleetx_tpu.obs.events import emit as obs_emit
+from fleetx_tpu.obs.registry import get_registry
+from fleetx_tpu.obs.tracing import span
 from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
 from fleetx_tpu.optims.optimizer import build_optimizer
 from fleetx_tpu.parallel import env as dist_env
 from fleetx_tpu.parallel.mesh import DATA_AXES, MeshConfig, build_mesh, use_mesh
 from fleetx_tpu.parallel.sharding import make_rules, param_shardings
 from fleetx_tpu.resilience.faults import faults
+from fleetx_tpu.utils.hw import peak_flops_per_chip
 from fleetx_tpu.utils.log import logger
 
 __all__ = ["CheckpointUnrestorable", "SentryAbort", "Trainer", "TrainState"]
@@ -224,6 +229,48 @@ class Trainer:
         self._sentry_consecutive = 0
         self.save_failures = 0  # periodic saves that failed (run survived)
         self._last_saved_meta = None  # (step, epoch, consumed_samples)
+
+        # observability (docs/OBSERVABILITY.md): live training gauges on
+        # the process registry (FLEETX_OBS_PORT exposes them). Gauges are
+        # process-wide last-writer-wins — one Trainer per process is the
+        # production shape; counters accumulate across Trainer instances
+        # (per-run numbers stay on self.sentry_skips/self.save_failures).
+        obs_http.maybe_start_from_env()
+        reg = get_registry()
+        self._obs_steps = reg.counter(
+            "fleetx_train_steps_total", "Optimizer steps applied")
+        self._obs_sentry_skips = reg.counter(
+            "fleetx_train_sentry_skips_total",
+            "Train steps skipped by the anomaly sentry")
+        self._obs_save_failures = reg.counter(
+            "fleetx_train_save_failures_total",
+            "Checkpoint saves that failed (run survived)")
+        self._obs_quarantines = reg.counter(
+            "fleetx_train_checkpoint_quarantines_total",
+            "Corrupt checkpoint steps quarantined during restore")
+        self._obs_loss = reg.gauge(
+            "fleetx_train_loss", "Loss averaged over the last logging window")
+        self._obs_lr = reg.gauge(
+            "fleetx_train_learning_rate", "Current learning rate")
+        self._obs_step_time = reg.histogram(
+            "fleetx_train_step_seconds",
+            "Per-step wall clock (logging-window mean samples)")
+        self._obs_tokens_per_s = reg.gauge(
+            "fleetx_train_tokens_per_second",
+            "Training throughput over the last logging window")
+        self._obs_mfu = reg.gauge(
+            "fleetx_train_mfu",
+            "Model-FLOPs utilization: cost_analysis flops / step time / "
+            "peak chip FLOPs")
+        # expose every instrument at zero immediately (matching the
+        # serving metrics, whose children exist from __init__): a healthy
+        # run must scrape as 0, not as absent-looking-like-broken
+        for fam in (self._obs_steps, self._obs_sentry_skips,
+                    self._obs_save_failures, self._obs_quarantines,
+                    self._obs_loss, self._obs_lr, self._obs_step_time,
+                    self._obs_tokens_per_s, self._obs_mfu):
+            fam.labels()
+        self._flops_per_step = None  # lazy; False = cost analysis failed
 
     # ------------------------------------------------------------------ init
     def init_state(self, sample_batch: Dict[str, np.ndarray]) -> TrainState:
@@ -489,6 +536,28 @@ class Trainer:
             cost = cost[0] if cost else None
         return cost
 
+    def _step_mfu(self, step_time_s: float) -> Optional[float]:
+        """Live MFU for the TRAIN log line and the ``fleetx_train_mfu``
+        gauge: the compiled train step's XLA flops (``cost_analysis``,
+        so remat recompute is included — a hardware utilization number,
+        the BENCH records' model-flops MFU stays the cross-config one)
+        over ``step_time_s`` and the peak FLOP/s. ``cost_analysis`` runs
+        on the SPMD-partitioned PER-DEVICE module, so its flops divide
+        by one chip's peak, not the fleet's — the ratio is then mesh-
+        size-independent. None when XLA exposes no flops for this step
+        (tried once, then cached)."""
+        if self._flops_per_step is None:
+            try:
+                cost = self.cost_analysis("train")
+                flops = float((cost or {}).get("flops", 0.0) or 0.0)
+                self._flops_per_step = flops if flops > 0 else False
+            except Exception:  # noqa: BLE001 — observability never aborts
+                self._flops_per_step = False
+        if not self._flops_per_step:
+            return None
+        peak = peak_flops_per_chip(jax.devices()[0])
+        return self._flops_per_step / max(step_time_s, 1e-9) / peak
+
     def _in_context(self, fn, name=None):
         """Run calls (and hence first-call tracing) inside the mesh + logical
         axis-rules contexts so nn.with_logical_constraint resolves."""
@@ -588,7 +657,11 @@ class Trainer:
             batches = iter(faults.wrap_train_data(train_data))
             while True:
                 try:
-                    batch = next(batches)
+                    # host data phase: visible in profiler traces next to
+                    # the step program (an input-bound run shows up as fat
+                    # train.data spans, not mystery gaps)
+                    with span("train.data", step=step):
+                        batch = next(batches)
                 except StopIteration:
                     break
                 except Exception:
@@ -628,7 +701,9 @@ class Trainer:
                     tokens_per_batch = int(np.prod(np.asarray(arr).shape))
                 device_batch = self._shard_batch(batch)
                 rng = dist_env.data_rank_key(step)
-                self.state, metrics = train_step(self.state, device_batch, rng)
+                with span("train.step", step=step):
+                    self.state, metrics = train_step(self.state, device_batch,
+                                                     rng)
                 if self._sentry_enabled and not bool(metrics["sentry_ok"]):
                     # skipped step: the batch was consumed from the stream
                     # (consumed_samples advances -> resume won't re-feed it)
@@ -639,6 +714,11 @@ class Trainer:
                     self.consumed_samples += self.cfg.Global.global_batch_size
                     self.sentry_skips += 1
                     self._sentry_consecutive += 1
+                    self._obs_sentry_skips.inc()
+                    obs_emit("sentry_skip", step=step,
+                             loss=float(metrics["loss"]),
+                             grad_norm=float(metrics["grad_norm"]),
+                             consecutive=self._sentry_consecutive)
                     logger.warning(
                         "sentry: skipped anomalous step %d (loss=%s "
                         "grad_norm=%s; %d skipped total, %d consecutive)",
@@ -650,6 +730,8 @@ class Trainer:
                         self._profiler_maybe_stop(summary=False)
                         self._guarded_save(epoch)
                         self.wait_for_checkpoints()
+                        obs_emit("sentry_abort", step=step,
+                                 consecutive=self._sentry_consecutive)
                         raise SentryAbort(
                             f"{self._sentry_consecutive} consecutive train "
                             f"steps skipped by the sentry at step {step} "
@@ -658,6 +740,7 @@ class Trainer:
                     continue
                 self._sentry_consecutive = 0
                 step += 1
+                self._obs_steps.inc()
                 # tick before the logging/eval/save hooks so the profiled
                 # step-time window measures the train step, not a periodic
                 # evaluation pass or checkpoint write
@@ -665,27 +748,38 @@ class Trainer:
                 self.consumed_samples += self.cfg.Global.global_batch_size
                 loss_window.append(metrics["loss"])
 
-                if step % self.logging_freq == 0:
-                    losses = np.mean([float(l) for l in loss_window])
-                    loss_window = []
-                    dt = (time.time() - t_last) / self.logging_freq
-                    t_last = time.time()
-                    ips_total = tokens_per_batch / dt
-                    self.module.training_step_end(
-                        {
-                            "epoch": epoch,
-                            "batch": step,
-                            "loss": losses,
-                            "batch_cost": dt,
-                            "ips_total": ips_total,
-                            "ips": ips_total / max(jax.process_count(), 1),
-                            "lr": float(self.lr_schedule(step)),
-                        }
-                    )
-                if self.eval_freq and valid_data is not None and step % self.eval_freq == 0:
-                    self.evaluate(valid_data, epoch=epoch)
-                if self.save_steps and step % self.save_steps == 0:
-                    self._guarded_save(epoch)
+                with span("train.callback", step=step):
+                    if step % self.logging_freq == 0:
+                        losses = np.mean([float(l) for l in loss_window])
+                        loss_window = []
+                        dt = (time.time() - t_last) / self.logging_freq
+                        t_last = time.time()
+                        ips_total = tokens_per_batch / dt
+                        lr = float(self.lr_schedule(step))
+                        mfu = self._step_mfu(dt)
+                        self._obs_loss.set(float(losses))
+                        self._obs_lr.set(lr)
+                        self._obs_step_time.observe(dt)
+                        self._obs_tokens_per_s.set(ips_total)
+                        if mfu is not None:
+                            self._obs_mfu.set(mfu)
+                        self.module.training_step_end(
+                            {
+                                "epoch": epoch,
+                                "batch": step,
+                                "loss": losses,
+                                "batch_cost": dt,
+                                "ips_total": ips_total,
+                                "ips": ips_total / max(jax.process_count(), 1),
+                                "lr": lr,
+                                "mfu": mfu,
+                            }
+                        )
+                    if (self.eval_freq and valid_data is not None
+                            and step % self.eval_freq == 0):
+                        self.evaluate(valid_data, epoch=epoch)
+                    if self.save_steps and step % self.save_steps == 0:
+                        self._guarded_save(epoch)
             if step >= self.max_steps:
                 break
         self._profiler_maybe_stop()
@@ -807,6 +901,9 @@ class Trainer:
             self.save(epoch=epoch)
         except Exception:
             self.save_failures += 1
+            self._obs_save_failures.inc()
+            obs_emit("save_failure", step=int(self.state.step),
+                     failures=self.save_failures)
             logger.exception(
                 "checkpoint save failed at step %d (%d failures so far); "
                 "training continues, next save in %d steps",
@@ -996,6 +1093,8 @@ class Trainer:
                 n += 1
                 dst = os.path.join(qdir, f"{name}.{n}")
             shutil.move(os.path.join(root, name), dst)
+            self._obs_quarantines.inc()
+            obs_emit("checkpoint_quarantine", step=step, moved_to=dst)
             logger.warning("quarantined corrupt checkpoint %s -> %s",
                            os.path.join(root, name), dst)
         mgr = self._ckpt_manager()

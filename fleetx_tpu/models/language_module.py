@@ -31,10 +31,15 @@ class LanguageModule(BasicModule):
     run_benchmark.sh:20-22)."""
 
     def training_step_end(self, log: Dict) -> None:
+        # mfu rides the same parsed line: tokens/s alone is not comparable
+        # across configs, and the BENCH_* records already report MFU — the
+        # live log should speak the same language (docs/OBSERVABILITY.md).
+        # "-" when XLA exposed no flops for this step program.
+        mfu = log.get("mfu")
         logger.train(
             "[train] epoch: %d, batch: %d, loss: %.9f, avg_batch_cost: %.5f sec, "
             "speed: %.2f step/s, ips_total: %.0f tokens/s, ips: %.0f tokens/s, "
-            "learning rate: %.3e",
+            "mfu: %s, learning rate: %.3e",
             log["epoch"],
             log["batch"],
             log["loss"],
@@ -42,6 +47,7 @@ class LanguageModule(BasicModule):
             1.0 / max(log["batch_cost"], 1e-9),
             log["ips_total"],
             log["ips"],
+            ("%.4f" % mfu) if mfu is not None else "-",
             log["lr"],
         )
 

@@ -1,0 +1,220 @@
+"""Stdlib-only HTTP exposition server for the observability layer.
+
+A daemon-thread ``ThreadingHTTPServer`` (no third-party deps) serving
+the endpoint contract docs/OBSERVABILITY.md pins down:
+
+- ``GET /metrics``  — Prometheus text exposition of the registry.
+- ``GET /snapshot`` — JSON: registry snapshot + event log window +
+  health status + span-ring stats (full spans via ``/trace``).
+- ``GET /trace``    — Chrome-trace JSON of the host span ring buffer
+  (load in chrome://tracing / Perfetto).
+- ``GET /healthz``  — 200 ``{"status": "ok"}`` while every registered
+  health probe passes, 503 ``{"status": "unhealthy", "failing": [...]}``
+  otherwise. The serving engine registers a drain-aware probe, so
+  ``request_shutdown()`` (SIGTERM) flips a replica to 503 *while it
+  drains* — exactly the rotate-me-out signal the multi-replica router
+  (ROADMAP item 3) load-balances on.
+
+Enable by setting ``FLEETX_OBS_PORT`` (``maybe_start_from_env`` is
+called by the Trainer and ServingEngine constructors, so any training
+or serving process becomes scrapeable with one env var; port 0 binds an
+ephemeral port — useful in tests). Binds ``FLEETX_OBS_HOST`` (default
+127.0.0.1: metrics can leak prompts/config — exposing beyond localhost
+is an explicit operator choice). All handlers are read-only: nothing an
+external scraper does can perturb the data path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+from fleetx_tpu.obs.events import get_event_log
+from fleetx_tpu.obs.registry import get_registry
+from fleetx_tpu.obs.tracing import get_recorder
+
+__all__ = [
+    "ObsServer",
+    "get_server",
+    "health_status",
+    "maybe_start_from_env",
+    "register_health",
+    "snapshot_payload",
+    "unregister_health",
+]
+
+_health_lock = threading.Lock()
+_health_probes: Dict[str, Callable[[], bool]] = {}
+
+
+def register_health(name: str, probe: Callable[[], bool]) -> None:
+    """Register a named liveness probe for ``/healthz``. ``probe()``
+    returns True when healthy; a raising probe counts as failing. Re-
+    registering a name replaces it (callers pair with
+    ``weakref.finalize`` to unregister at owner teardown)."""
+    with _health_lock:
+        _health_probes[name] = probe
+
+
+def unregister_health(name: str) -> None:
+    """Remove a probe (no-op when absent)."""
+    with _health_lock:
+        _health_probes.pop(name, None)
+
+
+def health_status() -> Tuple[bool, Dict[str, bool]]:
+    """(all healthy, {probe name: healthy}) over the registered probes.
+    No probes registered = healthy (a bare process serves 200)."""
+    with _health_lock:
+        probes = dict(_health_probes)
+    results = {}
+    for name, probe in probes.items():
+        try:
+            results[name] = bool(probe())
+        except Exception:  # noqa: BLE001 — a broken probe is "unhealthy"
+            results[name] = False
+    return all(results.values()), results
+
+
+def snapshot_payload() -> Dict:
+    """THE ``/snapshot`` payload (one definition — the HTTP handler and
+    ``tools/obs_dump.py``'s in-process dump both serve exactly this, so
+    the two surfaces cannot drift)."""
+    ok, results = health_status()
+    rec = get_recorder()
+    return {
+        "metrics": get_registry().snapshot(),
+        "events": get_event_log().snapshot(),
+        "health": {"ok": ok, "probes": results},
+        "spans": {"recorded": len(rec.spans()),
+                  "dropped": rec.dropped,
+                  "capacity": rec.capacity},
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Request handler over the module-global registry/events/spans."""
+
+    server_version = "fleetx-obs/1"
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, payload) -> None:
+        self._send(code, json.dumps(payload).encode(),
+                   "application/json; charset=utf-8")
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server contract
+        """Route the four read-only endpoints (404 otherwise)."""
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/metrics":
+            self._send(200, get_registry().prometheus_text().encode(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/healthz":
+            ok, results = health_status()
+            self._send_json(
+                200 if ok else 503,
+                {"status": "ok" if ok else "unhealthy",
+                 "probes": results,
+                 "failing": sorted(n for n, v in results.items() if not v)})
+        elif path == "/snapshot":
+            self._send_json(200, snapshot_payload())
+        elif path == "/trace":
+            self._send_json(200, get_recorder().chrome_trace())
+        else:
+            self._send_json(404, {"error": f"unknown path {self.path!r}",
+                                  "endpoints": ["/metrics", "/snapshot",
+                                                "/trace", "/healthz"]})
+
+    def log_message(self, format, *args):  # noqa: A002 — http.server API
+        """Silence per-request stderr lines (scrapes every few seconds
+        would otherwise flood training logs)."""
+
+
+class ObsServer:
+    """The exposition server: daemon thread, started once, stoppable."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+        self.host = host
+
+    @property
+    def port(self) -> int:
+        """Actual bound port (resolves port-0 ephemeral binds)."""
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        """Base URL of the running server."""
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ObsServer":
+        """Serve on a daemon thread; returns self. Idempotent."""
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                name="fleetx-obs-http", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Shut the listener down and join the serving thread."""
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+_server_lock = threading.Lock()
+_server: Optional[ObsServer] = None
+_server_failed = False
+
+
+def get_server() -> Optional[ObsServer]:
+    """The running env-gated server, if any."""
+    return _server
+
+
+def maybe_start_from_env() -> Optional[ObsServer]:
+    """Start the process-global server when ``FLEETX_OBS_PORT`` is set
+    (unset/empty = off; ``0`` = ephemeral port). Idempotent and cheap —
+    the Trainer and ServingEngine constructors call it — and a bind
+    failure (port taken by a sibling replica) logs and disables rather
+    than killing the workload."""
+    global _server, _server_failed
+    raw = os.environ.get("FLEETX_OBS_PORT", "")
+    if raw == "":
+        return None
+    with _server_lock:
+        if _server is not None:
+            return _server
+        if _server_failed:
+            return None  # already failed + logged once; don't retry/re-log
+        try:
+            port = int(raw)
+            _server = ObsServer(
+                port=port, host=os.environ.get("FLEETX_OBS_HOST",
+                                               "127.0.0.1")).start()
+        except Exception as e:  # noqa: BLE001 — obs must never kill the job
+            from fleetx_tpu.utils.log import logger
+
+            _server_failed = True
+            logger.error("obs: FLEETX_OBS_PORT=%s server failed to start "
+                         "(%s: %s); exposition disabled for this process",
+                         raw, type(e).__name__, e)
+            return None
+        from fleetx_tpu.utils.log import logger
+
+        logger.info("obs: exposition server listening on %s "
+                    "(/metrics /snapshot /trace /healthz)", _server.url)
+        return _server

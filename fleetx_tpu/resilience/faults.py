@@ -59,6 +59,8 @@ from typing import Iterable, Optional
 
 import numpy as np
 
+from fleetx_tpu.obs.events import emit as obs_emit
+
 __all__ = [
     "CkptFault",
     "DataFault",
@@ -239,10 +241,12 @@ class FaultInjector:
                 self._batch_counter += 1
                 if self._raise_sel and i in self._raise_sel:
                     self.injected["data_raise"] += 1
+                    obs_emit("fault_injected", fault="data_raise", batch=i)
                     raise DataFault(f"injected data failure at batch {i} "
                                     "(FLEETX_FAULT_DATA_RAISE_BATCH)")
                 if self._slow_sel and i in self._slow_sel:
                     self.injected["data_slow"] += 1
+                    obs_emit("fault_injected", fault="data_slow", batch=i)
                     time.sleep(self._plan.data_slow_s)
                 if self._nan_sel and i in self._nan_sel:
                     batch = self._poison(batch, i)
@@ -264,12 +268,14 @@ class FaultInjector:
                 f"FLEETX_FAULT_NAN_BATCH: batch {i} has no floating-point "
                 "leaf to poison (keys: " + ", ".join(batch) + ")")
         self.injected["nan"] += 1
+        obs_emit("fault_injected", fault="nan", batch=i)
         return out
 
     def on_checkpoint_save(self, step: int) -> None:
         """Raise :class:`CkptFault` when ``step`` matches the plan."""
         if self._ckpt_sel and step in self._ckpt_sel:
             self.injected["ckpt"] += 1
+            obs_emit("fault_injected", fault="ckpt", step=step)
             raise CkptFault(f"injected checkpoint-write failure at step "
                             f"{step} (FLEETX_FAULT_CKPT_SAVE_STEP)")
 
@@ -282,9 +288,11 @@ class FaultInjector:
             return
         if self._hang_sel and tick in self._hang_sel:
             self.injected["tick_hang"] += 1
+            obs_emit("fault_injected", fault="tick_hang", tick=tick)
             time.sleep(self._plan.tick_hang_s)
         if self._tick_sel and tick in self._tick_sel:
             self.injected["tick_raise"] += 1
+            obs_emit("fault_injected", fault="tick_raise", tick=tick)
             raise TickFault(f"injected decode-tick failure at tick {tick} "
                             "(FLEETX_FAULT_TICK_RAISE)")
 
@@ -294,6 +302,8 @@ class FaultInjector:
         included)."""
         if self._prefill_sel and attempt in self._prefill_sel:
             self.injected["prefill_raise"] += 1
+            obs_emit("fault_injected", fault="prefill_raise",
+                     attempt=attempt, request=request_id)
             raise PrefillFault(
                 f"injected prefill failure at attempt {attempt} "
                 f"(request {request_id}, FLEETX_FAULT_PREFILL_RAISE)")
@@ -308,6 +318,7 @@ class FaultInjector:
         hits = [int(r) for r in request_ids if int(r) in self._poison_sel]
         if hits:
             self.injected["poison"] += 1
+            obs_emit("fault_injected", fault="poison", requests=str(hits))
             raise PoisonFault(
                 f"injected poison-request failure (requests {hits} in the "
                 "decode batch, FLEETX_FAULT_POISON_REQUEST)")

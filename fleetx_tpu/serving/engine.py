@@ -90,12 +90,16 @@ import dataclasses
 import functools
 import os
 import time
+import weakref
 from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fleetx_tpu.obs import http as obs_http
+from fleetx_tpu.obs.events import emit as obs_emit
+from fleetx_tpu.obs.tracing import span
 from fleetx_tpu.models.gpt.generation import (
     GenerationConfig,
     _top_p_cutoff_bisect,
@@ -322,7 +326,9 @@ class ServingEngine:
         self._watchdog = None               # lazy single-thread executor
         self.hang_diagnostics = None        # banked by the watchdog
         self._shutting_down = False
+        self._dead = False  # RecoveryExhausted was raised; healthz -> 503
         self._shutdown_deadline = None
+        self._shutdown_event_pending = False
         self._prev_sigterm = None
         self._now = time.perf_counter  # swappable clock (chaos tests)
         if self.paged:
@@ -358,6 +364,23 @@ class ServingEngine:
         self._deactivate_jit = jax.jit(_deactivate)
         self._prefill_jits = {}  # bucketed prompt length -> jitted prefill
         self._donate_cache = donate
+        # observability (docs/OBSERVABILITY.md): one env var makes this
+        # replica scrapeable, and /healthz turns 503 the instant
+        # request_shutdown() flips _shutting_down — the rotate-me-out
+        # signal the multi-replica router (ROADMAP item 3) consumes.
+        # weakref probe: the health registry must never pin a dead engine.
+        obs_http.maybe_start_from_env()
+        self._health_name = f"serving_engine_{self.metrics.engine_label}"
+        ref = weakref.ref(self)
+
+        def _healthy():
+            eng = ref()
+            if eng is None:
+                return True  # owner gone; finalize unregisters shortly
+            return not (eng._shutting_down or eng._dead)
+
+        obs_http.register_health(self._health_name, _healthy)
+        weakref.finalize(self, obs_http.unregister_health, self._health_name)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -380,6 +403,7 @@ class ServingEngine:
         once :meth:`shutdown`/:meth:`request_shutdown` has been called."""
         if self._shutting_down:
             self.metrics.record_drain_reject()
+            obs_emit("drain_reject", engine=self.metrics.engine_label)
             raise ShuttingDown(
                 "engine is draining toward shutdown; submit to another "
                 "replica (in-flight requests are finishing under the "
@@ -391,6 +415,8 @@ class ServingEngine:
             self._expire_queued(self._now())
         if self.max_queue and self.scheduler.queue_depth >= self.max_queue:
             self.metrics.record_reject()
+            obs_emit("queue_reject", engine=self.metrics.engine_label,
+                     queue_depth=self.scheduler.queue_depth)
             raise QueueFull(
                 f"admission queue is full ({self.scheduler.queue_depth}/"
                 f"{self.max_queue} waiting, {self.cache_manager.active_count}"
@@ -465,6 +491,7 @@ class ServingEngine:
         victims; ``recovered`` marks a rolled-back-and-recovered tick).
         Raises only :class:`RecoveryExhausted` (the engine is dead)."""
         t0 = self._now()
+        self._flush_shutdown_event()
         if (self._shutting_down and self._shutdown_deadline is not None
                 and t0 >= self._shutdown_deadline
                 and (len(self.scheduler) or self._active)):
@@ -487,7 +514,8 @@ class ServingEngine:
                 snap.update(self._snapshot())
 
             try:
-                summary = self._step_inner(commit)
+                with span("serving.tick", tick=self._ticks):
+                    summary = self._step_inner(commit)
                 if summary["decoded"] or summary["admitted"]:
                     # a productive device tick proves the engine is healthy
                     # again — re-arm the recovery budget and strike counts
@@ -546,6 +574,7 @@ class ServingEngine:
         if req is None:
             return False
         self._evict(req, "cancelled", now)
+        obs_emit("request_cancelled", request=request_id)
         return True
 
     def _expire_queued(self, now):
@@ -554,6 +583,7 @@ class ServingEngine:
         out = []
         for req in self.scheduler.pop_expired(now):
             self._finalize(req, "timeout", now)
+            obs_emit("request_timeout", request=req.id, where="queue")
             out.append(req.id)
         return out
 
@@ -564,6 +594,7 @@ class ServingEngine:
         for req in list(self._active.values()):
             if req.deadline_s and now - req.submit_time > req.deadline_s:
                 self._evict(req, "timeout", now)
+                obs_emit("request_timeout", request=req.id, where="active")
                 out.append(req.id)
         return out
 
@@ -611,8 +642,11 @@ class ServingEngine:
         streams are untouched (nothing the failed tick produced was
         committed); the queue and every request are exactly pre-tick."""
         ctx, self._fault_ctx = self._fault_ctx, None
-        self._restore(snap)
+        with span("serving.rollback", tick=self._ticks):
+            self._restore(snap)
         victim = ctx[1] if ctx else None
+        obs_emit("tick_fault", tick=self._ticks, error=type(exc).__name__,
+                 during_prefill=bool(ctx), request=victim)
         logger.error(
             "serving: tick %d failed (%s: %s)%s; host state rolled back, "
             "running replay recovery", self._ticks, type(exc).__name__, exc,
@@ -635,6 +669,7 @@ class ServingEngine:
                     victim, self._prefill_strikes[victim])
                 self._finalize(req, "error", self._now())
                 self.metrics.record_poison()
+                obs_emit("poison_retired", request=victim, via="prefill")
                 retired.append(victim)
             self._prefill_strikes.pop(victim, None)
         elif not ctx and self._tick_strikes >= 2:
@@ -659,41 +694,50 @@ class ServingEngine:
         self._recoveries_consecutive += 1
         self.metrics.record_recovery()
         if self._recoveries_consecutive > self.max_recoveries:
+            # the engine is declaring itself dead — flip /healthz to 503
+            # BEFORE raising so the router stops sending traffic to a
+            # replica whose every further step will fail
+            self._dead = True
             raise RecoveryExhausted(
                 f"{self._recoveries_consecutive - 1} consecutive recoveries "
                 f"without a productive tick (FLEETX_SERVING_MAX_RECOVERIES="
                 f"{self.max_recoveries}); the fault is not request-shaped — "
                 "restart the engine/device")
-        old_active = sorted(self._active.items())
-        self._active = {}
-        self._tables_dev = None
-        self._tables_version = -1
-        self._state = self._init_state()
-        if self.paged:
-            self.cache_manager = PagedKVCacheManager(
-                self.model, self.slots, self.cache_len, self.num_pages,
-                self.page_size, prefix_cache=self.prefix_cache)
-        else:
-            self.cache_manager = SlotKVCacheManager(self.model, self.slots,
-                                                    self.cache_len)
-        retired = []
-        for _, req in old_active:
-            req.slot = None
-            try:
-                self._replay(req)
-            except Exception:  # noqa: BLE001 — isolate, don't cascade
-                logger.exception(
-                    "serving: request %d failed its own replay during "
-                    "recovery; quarantining it (finish_reason='error', %d "
-                    "partial tokens kept)", req.id, len(req.tokens))
-                if req.slot is not None:
-                    self.cache_manager.free(req.slot)
-                    req.slot = None
-                self._finalize(req, "error", self._now())
-                self.metrics.record_poison()
-                retired.append(req.id)
-                continue
-            self._active[req.slot] = req
+        with span("serving.recover",
+                  recovery=self.metrics.engine_recoveries):
+            old_active = sorted(self._active.items())
+            self._active = {}
+            self._tables_dev = None
+            self._tables_version = -1
+            self._state = self._init_state()
+            if self.paged:
+                self.cache_manager = PagedKVCacheManager(
+                    self.model, self.slots, self.cache_len, self.num_pages,
+                    self.page_size, prefix_cache=self.prefix_cache)
+            else:
+                self.cache_manager = SlotKVCacheManager(
+                    self.model, self.slots, self.cache_len)
+            retired = []
+            for _, req in old_active:
+                req.slot = None
+                try:
+                    self._replay(req)
+                except Exception:  # noqa: BLE001 — isolate, don't cascade
+                    logger.exception(
+                        "serving: request %d failed its own replay during "
+                        "recovery; quarantining it (finish_reason='error', "
+                        "%d partial tokens kept)", req.id, len(req.tokens))
+                    if req.slot is not None:
+                        self.cache_manager.free(req.slot)
+                        req.slot = None
+                    self._finalize(req, "error", self._now())
+                    self.metrics.record_poison()
+                    obs_emit("poison_retired", request=req.id, via="replay")
+                    retired.append(req.id)
+                    continue
+                self._active[req.slot] = req
+        obs_emit("engine_recovery", number=self.metrics.engine_recoveries,
+                 replayed=len(self._active), quarantined=len(retired))
         logger.warning(
             "serving: recovery #%d complete — %d request(s) replayed, %d "
             "quarantined", self.metrics.engine_recoveries,
@@ -801,6 +845,7 @@ class ServingEngine:
             "neighbors continue untouched", req.id, slot, len(req.tokens))
         self._evict(req, "error", self._now())
         self.metrics.record_poison()
+        obs_emit("poison_retired", request=req.id, via="bisection")
         return [req.id]
 
     def _run_device(self, fn):
@@ -833,6 +878,8 @@ class ServingEngine:
                 "queue_depth": self.scheduler.queue_depth,
                 "recoveries": self.metrics.engine_recoveries,
             }
+            obs_emit("tick_timeout", tick=self._ticks,
+                     timeout_s=self.tick_timeout_s)
             logger.error(
                 "serving: device tick exceeded FLEETX_SERVING_TICK_TIMEOUT_S"
                 "=%.3fs; diagnostics banked in engine.hang_diagnostics, "
@@ -855,6 +902,10 @@ class ServingEngine:
         self._shutting_down = True
         grace = self.grace_s if grace_s is None else float(grace_s)
         self._shutdown_deadline = self._now() + max(grace, 0.0)
+        # the shutdown event is emitted by the next step(), NOT here: this
+        # method is async-signal-safe (flag writes only) and the event
+        # log/registry take locks a signal context must never acquire
+        self._shutdown_event_pending = True
         logger.warning(
             "serving: shutdown requested — admission stopped, draining %d "
             "active + %d queued request(s) under a %.1fs grace window",
@@ -869,10 +920,23 @@ class ServingEngine:
         in flight or queued gets a terminal result. The checkpoint-safe
         shutdown seam the multi-replica router drains replicas through."""
         self.request_shutdown(grace_s)
+        # an idle engine drains without a single tick, so flush the
+        # deferred shutdown event here too (step() flushes it otherwise)
+        self._flush_shutdown_event()
         while len(self.scheduler) or self._active:
             self.step()  # the deadline check inside step() retires leftovers
         out, self._results = self._results, {}
         return out
+
+    def _flush_shutdown_event(self) -> None:
+        """Emit the shutdown event request_shutdown deferred (it may run
+        in a signal context, where the event log's locks are off-limits).
+        Called from step() and shutdown() — always outside signals."""
+        if self._shutdown_event_pending:
+            self._shutdown_event_pending = False
+            obs_emit("shutdown", engine=self.metrics.engine_label,
+                     active=len(self._active),
+                     queued=self.scheduler.queue_depth)
 
     def _retire_all(self, reason: str):
         """Retire every queued and in-flight request right now (grace
@@ -1131,7 +1195,7 @@ class ServingEngine:
                 jnp.asarray(req.top_p, jnp.float32),
                 step_key)
 
-    def _guarded_prefill(self, req: Request, fn, args):
+    def _guarded_prefill(self, req: Request, fn, args, bucket=None):
         """One prefill device call through the fault-injection hook;
         stores the returned cache. Deliberately NOT under the hung-tick
         watchdog: prefill calls legitimately include fresh-bucket XLA
@@ -1141,8 +1205,9 @@ class ServingEngine:
         the steady-state decode tick, the loop that actually wedges."""
         attempt = self._fault_prefills
         self._fault_prefills += 1
-        faults.on_serving_prefill(attempt, req.id)
-        cache, tok = fn(*args)
+        with span("serving.prefill", request=req.id, bucket=bucket):
+            faults.on_serving_prefill(attempt, req.id)
+            cache, tok = fn(*args)
         self.cache_manager.cache = cache
         return tok
 
@@ -1165,7 +1230,7 @@ class ServingEngine:
                 jnp.asarray(len(tokens), jnp.int32),
                 jnp.asarray(slot, jnp.int32),
                 *self._prefill_scalars(req, replay, step_key))
-        tok = self._guarded_prefill(req, fn, args)
+        tok = self._guarded_prefill(req, fn, args, bucket=bucket)
         return None if replay else (tok, carry_key)
 
     def _paged_prefill_call(self, req: Request, suffix, shared, lane,
@@ -1188,7 +1253,7 @@ class ServingEngine:
                 jnp.asarray(shared, jnp.int32),
                 jnp.asarray(self.cache_manager.tables[lane]),
                 *self._prefill_scalars(req, replay, step_key))
-        tok = self._guarded_prefill(req, fn, args)
+        tok = self._guarded_prefill(req, fn, args, bucket=bucket)
         return None if replay else (tok, carry_key)
 
     def _slot_prefill(self, req: Request):
@@ -1244,8 +1309,10 @@ class ServingEngine:
 
     def _admit(self, req: Request) -> None:
         self._fault_ctx = ("prefill", req.id)
-        tok, carry_key = (self._paged_prefill(req) if self.paged
-                          else self._slot_prefill(req))
+        with span("serving.admit", request=req.id,
+                  prompt_len=req.prompt_len):
+            tok, carry_key = (self._paged_prefill(req) if self.paged
+                              else self._slot_prefill(req))
         self._fault_ctx = None
         self._prefill_strikes.pop(req.id, None)  # survived its prefill
         tok = int(tok)  # host sync: the first token is now observable
@@ -1329,6 +1396,8 @@ class ServingEngine:
                 req = self._active[slot]
                 if not self.cache_manager.ensure_page(slot):
                     self._evict(req, "cache_full", now)
+                    obs_emit("cache_full", request=req.id,
+                             tokens=len(req.tokens))
                     retired.append(req.id)
             if not self._active:
                 return retired
@@ -1358,7 +1427,8 @@ class ServingEngine:
                 jax.block_until_ready(out)
             return out
 
-        cache, st, tok, done = self._run_device(run)
+        with span("serving.decode", batch=len(active_ids)):
+            cache, st, tok, done = self._run_device(run)
         self.cache_manager.cache = cache
         self._state = st
         tok_np = np.asarray(tok)  # host sync per tick
@@ -1406,6 +1476,7 @@ class ServingEngine:
     def _retire_error(self, req: Request, now: float) -> None:
         """Retire one request whose callback raised."""
         self._evict(req, "error", now)
+        obs_emit("callback_error", request=req.id)
 
     def _finalize(self, req: Request, reason: str, now: float) -> None:
         if req.slot in self._active:

@@ -7,6 +7,17 @@ the aggregate dict the benches and tests consume; ``log_snapshot()``
 surfaces the same line through ``utils/log.py`` (gate the cadence with
 ``FLEETX_SERVING_LOG_EVERY``).
 
+Since the unified observability layer (docs/OBSERVABILITY.md) every
+number here lives in :mod:`fleetx_tpu.obs.registry` instruments labeled
+``engine="<n>"`` — the class is a thin façade that names the metrics
+once and keeps the historical ``snapshot()``/attribute surface, while
+``GET /metrics`` (``FLEETX_OBS_PORT``) exposes the same series as
+Prometheus text. Latency/TTFT/tick distributions are bounded histogram
+reservoirs (``FLEETX_OBS_RESERVOIR`` samples), which retired the
+grow-forever ``ttft_s``/``queue_wait_s``/``latency_s``/
+``pages_per_request`` lists a long-lived replica used to accumulate:
+means stay exact (count/sum), percentiles describe the recent window.
+
 TTFT here is end-to-end: submit → the request's first token is on the
 host (queue wait + prefill + the device sync), which is what a caller
 actually observes — first requests include compile time, so warm up
@@ -15,82 +26,165 @@ before reading latencies as steady-state.
 
 from __future__ import annotations
 
-import collections
+import itertools
 import time
-from typing import Dict, List, Optional
+import weakref
+from typing import Dict, Optional
 
-import numpy as np
+from fleetx_tpu.obs.registry import MetricsRegistry, get_registry
 
 __all__ = ["ServingMetrics"]
 
 
-def _pct(values: List[float], q: float) -> Optional[float]:
-    if not values:
-        return None
-    return float(np.percentile(np.asarray(values), q))
+def _drop_series(owned) -> None:
+    """weakref.finalize target: remove every registry series a
+    ServingMetrics instance owned (its ``engine=<n>`` label is unique,
+    so a process that cycles engines would otherwise accumulate
+    dead-engine series in /metrics forever)."""
+    for family, labels in owned:
+        family.remove(**labels)
+
+
+def _ms(v: Optional[float]) -> Optional[float]:
+    return None if v is None else v * 1e3
 
 
 class ServingMetrics:
     """Counters + gauges for one serving engine (see module docstring)."""
 
-    def __init__(self, slots: int = 0):
+    _labels = itertools.count()
+
+    def __init__(self, slots: int = 0,
+                 registry: Optional[MetricsRegistry] = None):
+        reg = registry or get_registry()
+        self.registry = reg
+        self.engine_label = str(next(self._labels))
+        lab = {"engine": self.engine_label}
+        # (family, labels) of every series this instance creates; a
+        # weakref finalizer removes them when the instance dies, so the
+        # registry's memory stays bounded across engine restarts. A plain
+        # list captured by closure — the finalizer must not pin self.
+        self._owned = owned = []
+
+        def child(fam):
+            owned.append((fam, dict(lab)))
+            return fam.labels(**lab)
+
+        def counter(name, help):
+            return child(reg.counter(name, help, ("engine",)))
+
+        def gauge(name, help):
+            return child(reg.gauge(name, help, ("engine",)))
+
+        def hist(name, help):
+            return child(reg.histogram(name, help, ("engine",)))
+
         self.slots = slots
-        self.submitted = 0
-        self.admitted = 0
-        self.retired = 0
-        self.rejected = 0
-        self.tokens_generated = 0
-        self.ticks = 0
-        self.finish_reasons: Dict[str, int] = {}
-        self.ttft_s: List[float] = []
-        self.queue_wait_s: List[float] = []
-        self.latency_s: List[float] = []
-        self.queue_depth = 0
-        self.active_slots = 0
-        self._queue_depth_sum = 0
-        self._queue_depth_peak = 0
-        self._occupancy_sum = 0
-        self._first_token_t: Optional[float] = None
-        self._last_token_t: Optional[float] = None
-        # paged-cache counters (zero/empty on the slot path so the
-        # snapshot schema is stable across modes)
-        self.prefix_queries = 0
-        self.prefix_hits = 0
-        self.prefill_tokens_saved = 0
-        self.prompt_tokens = 0
-        self.pages_per_request: List[int] = []
-        self.pages_in_use = 0
-        self.pages_total = 0
-        self._page_occupancy_sum = 0.0
-        self._page_occupancy_peak = 0.0
-        self._page_ticks = 0
+        self._c_submitted = counter(
+            "fleetx_serving_submitted_total",
+            "Requests that entered the admission queue")
+        self._c_admitted = counter(
+            "fleetx_serving_admitted_total",
+            "Requests that won a decode lane (prefill ran)")
+        self._retired_family = reg.counter(
+            "fleetx_serving_retired_total",
+            "Requests retired, by finish_reason",
+            ("engine", "reason"))
+        self._c_rejected = counter(
+            "fleetx_serving_rejected_total",
+            "Submits refused by admission control (queue full)")
+        self._c_drain_rejects = counter(
+            "fleetx_serving_drain_rejects_total",
+            "Submits refused because the engine was shutting down")
+        self._c_tokens = counter(
+            "fleetx_serving_tokens_total",
+            "Decode tokens that reached the host")
+        self._c_ticks = counter(
+            "fleetx_serving_ticks_total",
+            "Scheduler ticks executed")
         # crash-safety counters (docs/RESILIENCE.md serving-recovery):
         # recoveries = replay-recovery passes the engine ran, poison =
-        # requests quarantined by bisection/replay, drain_rejects = submits
-        # refused because the engine was shutting down. Tick wall-clock
-        # samples make the recovery cost observable (a recovery tick re-
-        # prefills every active request, so its duration spikes).
-        self.engine_recoveries = 0
-        self.poison_retired = 0
-        self.drain_rejects = 0
-        # bounded window: one sample per tick forever would grow without
-        # limit on a continuously-ticking replica (and np.percentile over
-        # it would too); 4096 ticks ≈ the recent-behavior window the
-        # percentiles are meant to describe
-        self.tick_s = collections.deque(maxlen=4096)
+        # requests quarantined by bisection/replay
+        self._c_recoveries = counter(
+            "fleetx_serving_engine_recoveries_total",
+            "Replay-recovery passes (device state rebuilt from host truth)")
+        self._c_poison = counter(
+            "fleetx_serving_poison_retired_total",
+            "Requests quarantined as poison (bisection or replay failure)")
+        # paged-cache counters (zero on the slot path so the snapshot
+        # schema is stable across modes)
+        self._c_prefix_queries = counter(
+            "fleetx_serving_prefix_queries_total",
+            "Paged admissions that consulted the prefix trie")
+        self._c_prefix_hits = counter(
+            "fleetx_serving_prefix_hits_total",
+            "Paged admissions that reused shared prefix pages")
+        self._c_prefill_saved = counter(
+            "fleetx_serving_prefill_tokens_saved_total",
+            "Prompt tokens whose prefill the prefix cache skipped")
+        self._c_prompt_tokens = counter(
+            "fleetx_serving_prompt_tokens_total",
+            "Prompt tokens across admitted paged requests")
+        self._g_queue_depth = gauge(
+            "fleetx_serving_queue_depth",
+            "Requests currently waiting for a decode lane")
+        self._g_active_slots = gauge(
+            "fleetx_serving_active_slots",
+            "Decode lanes currently occupied")
+        self._g_slots = gauge(
+            "fleetx_serving_slots",
+            "Configured decode lanes of this engine")
+        self._g_slots.set(slots)
+        self._g_pages_in_use = gauge(
+            "fleetx_serving_pages_in_use",
+            "KV pages currently allocated (paged mode)")
+        self._g_pages_total = gauge(
+            "fleetx_serving_pages_total",
+            "Usable KV pages in the shared pool (paged mode)")
+        self._h_ttft = hist(
+            "fleetx_serving_ttft_seconds",
+            "Submit-to-first-token latency (end-to-end, host observed)")
+        self._h_queue_wait = hist(
+            "fleetx_serving_queue_wait_seconds",
+            "Time spent waiting in the admission queue")
+        self._h_latency = hist(
+            "fleetx_serving_request_latency_seconds",
+            "Submit-to-retire request latency")
+        # per-tick wall-clock feeds the p50/p99 that make recovery/
+        # quarantine cost visible next to steady-state ticks
+        self._h_tick = hist(
+            "fleetx_serving_tick_seconds",
+            "Scheduler tick wall-clock")
+        self._h_queue_depth = hist(
+            "fleetx_serving_queue_depth_per_tick",
+            "Queue depth sampled once per tick (mean/peak feed snapshot)")
+        self._h_active = hist(
+            "fleetx_serving_active_slots_per_tick",
+            "Occupied lanes sampled once per tick")
+        self._h_page_occ = hist(
+            "fleetx_serving_page_occupancy",
+            "Page-pool occupancy fraction sampled once per tick")
+        self._h_pages_per_req = hist(
+            "fleetx_serving_pages_per_request",
+            "Fresh (non-shared) pages claimed per admitted paged request")
+        self._reasons: Dict[str, object] = {}  # reason -> counter child
+        self._first_token_t: Optional[float] = None
+        self._last_token_t: Optional[float] = None
+        weakref.finalize(self, _drop_series, owned)
 
+    # ------------------------------------------------- lifecycle recording
     def record_submit(self) -> None:
         """A request entered the admission queue."""
-        self.submitted += 1
+        self._c_submitted.inc()
 
     def record_admit(self, queue_wait_s: float) -> None:
         """A request won a slot after waiting ``queue_wait_s``."""
-        self.admitted += 1
-        self.queue_wait_s.append(float(queue_wait_s))
+        self._c_admitted.inc()
+        self._h_queue_wait.observe(queue_wait_s)
 
     def record_first_token(self, ttft_s: float) -> None:
         """First token of a request reached the host (end-to-end TTFT)."""
-        self.ttft_s.append(float(ttft_s))
+        self._h_ttft.observe(ttft_s)
 
     def record_tokens(self, n: int) -> None:
         """``n`` decode tokens reached the host this tick."""
@@ -98,25 +192,25 @@ class ServingMetrics:
         if self._first_token_t is None:
             self._first_token_t = now
         self._last_token_t = now
-        self.tokens_generated += n
+        self._c_tokens.inc(n)
 
     def record_reject(self) -> None:
         """A submit was refused by admission control (queue full)."""
-        self.rejected += 1
+        self._c_rejected.inc()
 
     def record_recovery(self) -> None:
         """The engine ran one replay-recovery pass (device state rebuilt
         and every active request re-prefilled from its host history)."""
-        self.engine_recoveries += 1
+        self._c_recoveries.inc()
 
     def record_poison(self) -> None:
         """A poison request was quarantined (bisection or replay failure)
         and retired with ``finish_reason="error"``."""
-        self.poison_retired += 1
+        self._c_poison.inc()
 
     def record_drain_reject(self) -> None:
         """A submit was refused because the engine is shutting down."""
-        self.drain_rejects += 1
+        self._c_drain_rejects.inc()
 
     def record_prefix(self, shared_tokens: int, prompt_tokens: int,
                       pages: int) -> None:
@@ -124,31 +218,99 @@ class ServingMetrics:
         the prefix cache (their prefill was skipped), ``pages`` is the
         FRESH pages the request claimed (trie-shared pages excluded —
         they cost nothing, which is the point)."""
-        self.prefix_queries += 1
+        self._c_prefix_queries.inc()
         if shared_tokens > 0:
-            self.prefix_hits += 1
-        self.prefill_tokens_saved += int(shared_tokens)
-        self.prompt_tokens += int(prompt_tokens)
-        self.pages_per_request.append(int(pages))
+            self._c_prefix_hits.inc()
+        self._c_prefill_saved.inc(int(shared_tokens))
+        self._c_prompt_tokens.inc(int(prompt_tokens))
+        self._h_pages_per_req.observe(int(pages))
 
     def observe_pages(self, pages_in_use: int, pages_total: int) -> None:
         """Per-tick page-pool gauge sample (paged mode only)."""
-        self.pages_in_use = pages_in_use
-        self.pages_total = pages_total
-        occ = pages_in_use / pages_total if pages_total else 0.0
-        self._page_occupancy_sum += occ
-        self._page_occupancy_peak = max(self._page_occupancy_peak, occ)
-        self._page_ticks += 1
+        self._g_pages_in_use.set(pages_in_use)
+        self._g_pages_total.set(pages_total)
+        self._h_page_occ.observe(
+            pages_in_use / pages_total if pages_total else 0.0)
 
     def record_retire(self, latency_s: float, reason: str) -> None:
         """A request finished (``reason``: eos | max_length | cache_full |
-        timeout | cancelled | error)."""
-        self.retired += 1
-        self.latency_s.append(float(latency_s))
-        self.finish_reasons[reason] = self.finish_reasons.get(reason, 0) + 1
+        timeout | cancelled | error | shutdown)."""
+        child = self._reasons.get(reason)
+        if child is None:
+            labels = {"engine": self.engine_label, "reason": reason}
+            self._owned.append((self._retired_family, labels))
+            child = self._reasons[reason] = self._retired_family.labels(
+                **labels)
+        child.inc()
+        self._h_latency.observe(latency_s)
 
-    # admission-control counters are views over finish_reasons — one source
-    # of truth, no parallel state to drift
+    def observe_tick(self, queue_depth: int, active_slots: int,
+                     tick_s: Optional[float] = None) -> None:
+        """Per-tick gauge sample from the engine's scheduler loop;
+        ``tick_s`` is the tick's wall-clock (feeds the p50/p99 that make
+        recovery/quarantine cost visible next to steady-state ticks)."""
+        self._c_ticks.inc()
+        self._g_queue_depth.set(queue_depth)
+        self._g_active_slots.set(active_slots)
+        self._h_queue_depth.observe(queue_depth)
+        self._h_active.observe(active_slots)
+        if tick_s is not None:
+            self._h_tick.observe(tick_s)
+
+    # ------------------------------------------------- attribute surface
+    # (historic int attributes, now views over the registry children —
+    # one source of truth, no parallel state to drift)
+    @property
+    def submitted(self) -> int:
+        """Requests submitted."""
+        return int(self._c_submitted.value)
+
+    @property
+    def admitted(self) -> int:
+        """Requests admitted into a decode lane."""
+        return int(self._c_admitted.value)
+
+    @property
+    def retired(self) -> int:
+        """Requests retired, any finish_reason."""
+        return sum(int(c.value) for c in self._reasons.values())
+
+    @property
+    def rejected(self) -> int:
+        """Submits rejected by the bounded queue."""
+        return int(self._c_rejected.value)
+
+    @property
+    def tokens_generated(self) -> int:
+        """Decode tokens that reached the host."""
+        return int(self._c_tokens.value)
+
+    @property
+    def ticks(self) -> int:
+        """Scheduler ticks executed."""
+        return int(self._c_ticks.value)
+
+    @property
+    def finish_reasons(self) -> Dict[str, int]:
+        """``{finish_reason: count}`` over this engine's retirements."""
+        return {r: int(c.value) for r, c in self._reasons.items()
+                if int(c.value)}
+
+    @property
+    def engine_recoveries(self) -> int:
+        """Replay-recovery passes this engine ran."""
+        return int(self._c_recoveries.value)
+
+    @property
+    def poison_retired(self) -> int:
+        """Requests quarantined as poison."""
+        return int(self._c_poison.value)
+
+    @property
+    def drain_rejects(self) -> int:
+        """Submits refused during shutdown drain."""
+        return int(self._c_drain_rejects.value)
+
     @property
     def timeouts(self) -> int:
         """Requests retired by queue-TTL or total-deadline expiry."""
@@ -164,26 +326,83 @@ class ServingMetrics:
         """Requests retired because their ``on_token`` callback raised."""
         return self.finish_reasons.get("error", 0)
 
-    def observe_tick(self, queue_depth: int, active_slots: int,
-                     tick_s: Optional[float] = None) -> None:
-        """Per-tick gauge sample from the engine's scheduler loop;
-        ``tick_s`` is the tick's wall-clock (feeds the p50/p99 that make
-        recovery/quarantine cost visible next to steady-state ticks)."""
-        self.ticks += 1
-        self.queue_depth = queue_depth
-        self.active_slots = active_slots
-        self._queue_depth_sum += queue_depth
-        self._queue_depth_peak = max(self._queue_depth_peak, queue_depth)
-        self._occupancy_sum += active_slots
-        if tick_s is not None:
-            self.tick_s.append(float(tick_s))
+    @property
+    def prefix_queries(self) -> int:
+        """Paged admissions that consulted the prefix trie."""
+        return int(self._c_prefix_queries.value)
 
+    @property
+    def prefix_hits(self) -> int:
+        """Paged admissions that reused shared pages."""
+        return int(self._c_prefix_hits.value)
+
+    @property
+    def prefill_tokens_saved(self) -> int:
+        """Prompt tokens whose prefill the prefix cache skipped."""
+        return int(self._c_prefill_saved.value)
+
+    @property
+    def prompt_tokens(self) -> int:
+        """Prompt tokens across admitted paged requests."""
+        return int(self._c_prompt_tokens.value)
+
+    @property
+    def queue_depth(self) -> int:
+        """Last sampled queue depth."""
+        return int(self._g_queue_depth.value)
+
+    @property
+    def active_slots(self) -> int:
+        """Last sampled occupied-lane count."""
+        return int(self._g_active_slots.value)
+
+    @property
+    def pages_in_use(self) -> int:
+        """Last sampled allocated-page count (paged mode)."""
+        return int(self._g_pages_in_use.value)
+
+    @property
+    def pages_total(self) -> int:
+        """Last sampled usable-pool size (paged mode)."""
+        return int(self._g_pages_total.value)
+
+    # bounded-reservoir views (regression-tested: a 10k-retire loop must
+    # hold these at the FLEETX_OBS_RESERVOIR cap, not 10k entries)
+    @property
+    def ttft_s(self):
+        """TTFT reservoir (newest ``FLEETX_OBS_RESERVOIR`` samples)."""
+        return self._h_ttft.reservoir
+
+    @property
+    def queue_wait_s(self):
+        """Queue-wait reservoir."""
+        return self._h_queue_wait.reservoir
+
+    @property
+    def latency_s(self):
+        """Request-latency reservoir."""
+        return self._h_latency.reservoir
+
+    @property
+    def tick_s(self):
+        """Tick wall-clock reservoir."""
+        return self._h_tick.reservoir
+
+    @property
+    def pages_per_request(self):
+        """Fresh-pages-per-request reservoir."""
+        return self._h_pages_per_req.reservoir
+
+    # ------------------------------------------------------------ snapshot
     def snapshot(self) -> Dict:
         """Aggregate view: counters, queue/occupancy stats, TTFT
         percentiles, decode tokens/s."""
         span = None
         if self._first_token_t is not None and self._last_token_t is not None:
             span = self._last_token_t - self._first_token_t
+        ticks = self.ticks
+        ttft_p50, ttft_p95 = self._h_ttft.quantiles((50, 95))
+        tick_p50, tick_p99 = self._h_tick.quantiles((50, 99))
         return {
             "submitted": self.submitted,
             "admitted": self.admitted,
@@ -193,28 +412,23 @@ class ServingMetrics:
             "cancels": self.cancels,
             "callback_errors": self.callback_errors,
             "tokens_generated": self.tokens_generated,
-            "ticks": self.ticks,
+            "ticks": ticks,
             "queue_depth": self.queue_depth,
-            "queue_depth_mean": (self._queue_depth_sum / self.ticks
-                                 if self.ticks else 0.0),
-            "queue_depth_peak": self._queue_depth_peak,
+            "queue_depth_mean": (self._h_queue_depth.sum / ticks
+                                 if ticks else 0.0),
+            "queue_depth_peak": int(self._h_queue_depth.max or 0),
             "active_slots": self.active_slots,
             "slots": self.slots,
-            "slot_occupancy_mean": (self._occupancy_sum / self.ticks / self.slots
-                                    if self.ticks and self.slots else 0.0),
-            "ttft_ms_mean": (float(np.mean(self.ttft_s)) * 1e3
-                             if self.ttft_s else None),
-            "ttft_ms_p50": (None if not self.ttft_s
-                            else _pct(self.ttft_s, 50) * 1e3),
-            "ttft_ms_p95": (None if not self.ttft_s
-                            else _pct(self.ttft_s, 95) * 1e3),
-            "queue_wait_ms_mean": (float(np.mean(self.queue_wait_s)) * 1e3
-                                   if self.queue_wait_s else None),
-            "latency_ms_mean": (float(np.mean(self.latency_s)) * 1e3
-                                if self.latency_s else None),
+            "slot_occupancy_mean": (self._h_active.sum / ticks / self.slots
+                                    if ticks and self.slots else 0.0),
+            "ttft_ms_mean": _ms(self._h_ttft.mean),
+            "ttft_ms_p50": _ms(ttft_p50),
+            "ttft_ms_p95": _ms(ttft_p95),
+            "queue_wait_ms_mean": _ms(self._h_queue_wait.mean),
+            "latency_ms_mean": _ms(self._h_latency.mean),
             "decode_tokens_per_s": (self.tokens_generated / span
                                     if span and span > 0 else None),
-            "finish_reasons": dict(self.finish_reasons),
+            "finish_reasons": self.finish_reasons,
             # paged-cache story: how much prefill the prefix trie saved
             # and how full the page pool ran (zeros on the slot path)
             "prefix_queries": self.prefix_queries,
@@ -225,24 +439,18 @@ class ServingMetrics:
             "prefill_tokens_saved_frac": (
                 self.prefill_tokens_saved / self.prompt_tokens
                 if self.prompt_tokens else 0.0),
-            "pages_per_request_mean": (
-                float(np.mean(self.pages_per_request))
-                if self.pages_per_request else None),
+            "pages_per_request_mean": self._h_pages_per_req.mean,
             "pages_in_use": self.pages_in_use,
             "pages_total": self.pages_total,
-            "page_occupancy_mean": (self._page_occupancy_sum
-                                    / self._page_ticks
-                                    if self._page_ticks else 0.0),
-            "page_occupancy_peak": self._page_occupancy_peak,
+            "page_occupancy_mean": (self._h_page_occ.mean or 0.0),
+            "page_occupancy_peak": (self._h_page_occ.max or 0.0),
             # crash-safety story: how often the engine recovered, what it
             # quarantined, what shutdown turned away, and what a tick costs
             "engine_recoveries": self.engine_recoveries,
             "poison_retired": self.poison_retired,
             "drain_rejects": self.drain_rejects,
-            "tick_ms_p50": (None if not self.tick_s
-                            else _pct(self.tick_s, 50) * 1e3),
-            "tick_ms_p99": (None if not self.tick_s
-                            else _pct(self.tick_s, 99) * 1e3),
+            "tick_ms_p50": _ms(tick_p50),
+            "tick_ms_p99": _ms(tick_p99),
         }
 
     def log_snapshot(self) -> None:

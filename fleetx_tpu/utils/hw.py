@@ -1,0 +1,37 @@
+"""Hardware peak-FLOPs lookup shared by MFU accounting everywhere.
+
+One table (public spec sheets, dense bf16) so ``bench.py``'s BENCH_*
+records, the Trainer's live ``mfu`` gauge/log-line, and any future
+report all divide by the SAME peak — MFU numbers stay comparable across
+surfaces. Unknown accelerators assume v5e-class so the ratio is at
+least stable; CPU gets a placeholder that keeps smoke runs finite.
+"""
+
+from __future__ import annotations
+
+__all__ = ["PEAK_FLOPS", "peak_flops_per_chip"]
+
+# Peak dense bf16 FLOP/s per chip by device kind (public spec sheets).
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v4 lite": 138e12,   # v4i
+    "TPU v3": 123e12,
+    "TPU v6 lite": 918e12,   # Trillium
+    "TPU v6e": 918e12,
+    "cpu": 1e12,             # placeholder so CPU smoke runs don't div0
+}
+
+
+def peak_flops_per_chip(device) -> float:
+    """Peak dense bf16 FLOP/s for ``device`` (a jax Device or anything
+    with ``device_kind``). Longest-prefix match so 'TPU v4 lite'
+    resolves before 'TPU v4'; unknown kinds assume v5e-class."""
+    kind = getattr(device, "device_kind", "cpu")
+    for name in sorted(PEAK_FLOPS, key=len, reverse=True):
+        if kind.startswith(name):
+            return PEAK_FLOPS[name]
+    return 197e12  # unknown accelerator: assume v5e-class

@@ -82,6 +82,38 @@ def test_env_vars_documented():
         f"{missing}")
 
 
+def test_metric_names_linted_and_documented():
+    """Metric-name drift gate (ISSUE 9): every registry metric registered
+    under fleetx_tpu/ with a literal name must be snake_case with a
+    ``fleetx_`` prefix AND appear in the docs/OBSERVABILITY.md metric
+    table — the Prometheus exposition surface cannot drift undocumented.
+    (Names built from variables would evade a static lint, so literal
+    first-arg registration is the house style; the regex below is that
+    contract.)"""
+    import glob
+    import re
+
+    reg_call = re.compile(
+        r"\b(?:counter|gauge|histogram|hist)\(\s*[\"']([A-Za-z0-9_.-]+)[\"']")
+    names = set()
+    for path in glob.glob(os.path.join(REPO, "fleetx_tpu", "**", "*.py"),
+                          recursive=True):
+        with open(path) as f:
+            names |= set(reg_call.findall(f.read()))
+    assert names, "metric-name lint found no registrations (regex rotted?)"
+    bad = sorted(n for n in names
+                 if not re.match(r"^fleetx_[a-z0-9_]*[a-z0-9]$", n))
+    assert not bad, (
+        f"registry metrics under fleetx_tpu/ must be snake_case with a "
+        f"fleetx_ prefix: {bad}")
+    with open(os.path.join(REPO, "docs", "OBSERVABILITY.md")) as f:
+        doc = f.read()
+    undocumented = sorted(n for n in names if f"`{n}`" not in doc)
+    assert not undocumented, (
+        f"metrics registered in code but missing from the "
+        f"docs/OBSERVABILITY.md metric table: {undocumented}")
+
+
 def test_shell_scripts_parse():
     """bash -n over every launch/benchmark script (the reference gates its
     shell surface through CI runs; we gate syntax statically)."""

@@ -87,6 +87,36 @@ def test_kernel_view_nested_events_on_one_track(tmp_path, caplog):
     assert "80.0%" in text and "20.0%" in text
 
 
+def test_kernel_view_ranks_ops_by_total_self_time(tmp_path, caplog):
+    """The top-ops table is ordered by descending total self time and the
+    per-op call counts/percentages are right (ISSUE 9: the gzipped
+    .trace.json.gz parse path gets explicit rank coverage)."""
+    from fleetx_tpu.utils.profiler_summary import _kernel
+
+    events = [
+        _meta(3, pname="/device:TPU:0"),
+        _meta(3, tid=2, tname="XLA Ops"),
+        # big: 1 call x 600us; mid: 3 calls x 100us; small: 2 x 50us
+        {"ph": "X", "pid": 3, "tid": 2, "name": "big", "ts": 0, "dur": 600},
+        {"ph": "X", "pid": 3, "tid": 2, "name": "mid", "ts": 600, "dur": 100},
+        {"ph": "X", "pid": 3, "tid": 2, "name": "mid", "ts": 700, "dur": 100},
+        {"ph": "X", "pid": 3, "tid": 2, "name": "small", "ts": 800, "dur": 50},
+        {"ph": "X", "pid": 3, "tid": 2, "name": "mid", "ts": 850, "dur": 100},
+        {"ph": "X", "pid": 3, "tid": 2, "name": "small", "ts": 950, "dur": 50},
+    ]
+    log_dir = _write_trace(tmp_path, events)
+    with caplog.at_level(logging.INFO, logger="fleetx_tpu"):
+        _kernel(log_dir, top_k=2)  # top_k must also truncate: small absent
+    rows = [l for l in caplog.text.splitlines()
+            if any(n in l for n in ("big", "mid", "small"))]
+    assert len(rows) == 2, rows
+    assert "big" in rows[0] and "60.0%" in rows[0]
+    assert "mid" in rows[1] and "30.0%" in rows[1]
+    assert not any("small" in r for r in rows)
+    # counts column: mid ran 3 times
+    assert rows[1].split()[-2] == "3", rows[1]
+
+
 def test_kernel_view_no_trace(tmp_path, caplog):
     from fleetx_tpu.utils.profiler_summary import _kernel
 

@@ -158,6 +158,37 @@ def test_chaos_check_serving_recovery_scenarios(tmp_path, capsys):
         assert f"PASS {name}" in out
 
 
+def test_obs_dump_scrapes_live_server(tmp_path):
+    """tools/obs_dump.py against a live exposition server writes the
+    three payloads; the Chrome trace parses and carries the host spans
+    (docs/OBSERVABILITY.md endpoint contract)."""
+    sys.path.insert(0, REPO)
+    from fleetx_tpu.obs import ObsServer, emit, span
+    from tools import obs_dump
+
+    emit("obs_dump_probe")
+    with span("obs.dump.probe"):
+        pass
+    srv = ObsServer(port=0).start()
+    try:
+        out = tmp_path / "obs"
+        rc = obs_dump.main(["--url", srv.url, "--out-dir", str(out)])
+        assert rc == 0
+        text = (out / "metrics.prom").read_text()
+        assert "fleetx_events_total" in text
+        snap = json.loads((out / "snapshot.json").read_text())
+        assert any(e["kind"] == "obs_dump_probe" for e in snap["events"])
+        trace = json.loads((out / "trace.json").read_text())
+        assert any(e.get("name") == "obs.dump.probe"
+                   for e in trace["traceEvents"])
+    finally:
+        srv.stop()
+    # a dead endpoint is a loud non-zero exit, not a silent empty dump
+    assert obs_dump.main(["--url", "http://127.0.0.1:9",
+                          "--out-dir", str(tmp_path / "dead"),
+                          "--timeout-s", "0.5"]) == 1
+
+
 def test_precomputed_embeddings_feed_text_image_dataset(tmp_path):
     """The tool's output is directly mmap-consumable by TextImageDataset."""
     sys.path.insert(0, REPO)

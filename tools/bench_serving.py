@@ -206,6 +206,10 @@ def _run_continuous(engine, workload):
         "ttft_ms_p50": round(snap["ttft_ms_p50"], 2),
         "ttft_ms_p95": round(snap["ttft_ms_p95"], 2),
     }
+    # full metric context rides the record (docs/OBSERVABILITY.md): the
+    # summary fields above are the headline, obs_snapshot is everything
+    # the engine's registry instruments saw this pass
+    detail["obs_snapshot"] = snap
     if getattr(engine, "paged", False):
         detail.update({
             "prefix_hit_rate": round(snap["prefix_hit_rate"], 3),

@@ -135,9 +135,11 @@ def _params(trainer):
 
 
 def scenario_sentry(tmp):
-    """NaN batch skipped; params byte-identical to the clean stream."""
+    """NaN batch skipped; params byte-identical to the clean stream; the
+    skip banked its structured event (docs/OBSERVABILITY.md)."""
     import numpy as np
 
+    from fleetx_tpu.obs import get_event_log
     from fleetx_tpu.resilience.faults import faults
 
     over = {"Engine.max_steps": 3}
@@ -152,7 +154,11 @@ def scenario_sentry(tmp):
     assert int(faulty.state.step) == int(clean.state.step) == 3
     for a, b in zip(_params(clean), _params(faulty)):
         assert np.array_equal(a, b), "params diverged after sentry skip"
-    return "1 NaN step skipped, params byte-identical"
+    ev = get_event_log()
+    assert ev.find("fault_injected", fault="nan"), "nan injection unbanked"
+    skips = ev.find("sentry_skip")
+    assert len(skips) == 1 and skips[0].attrs["step"] == 1, skips
+    return "1 NaN step skipped, params byte-identical, sentry_skip banked"
 
 
 def scenario_ckpt(tmp):
@@ -175,7 +181,11 @@ def scenario_ckpt(tmp):
     assert int(t2.state.step) == 2, int(t2.state.step)
     qdir = os.path.join(cfg.Engine.save_load.output_dir, "quarantine")
     assert os.path.isdir(qdir) and os.listdir(qdir)
-    return "corrupt step 4 quarantined, resumed from step 2"
+    from fleetx_tpu.obs import get_event_log
+
+    quar = get_event_log().find("checkpoint_quarantine", step=4)
+    assert quar, "quarantine left no structured event"
+    return "corrupt step 4 quarantined (event banked), resumed from step 2"
 
 
 def scenario_serving(tmp):
@@ -237,7 +247,16 @@ def scenario_serving(tmp):
     m = eng.metrics
     assert m.rejected == 1 and m.timeouts == 1 and m.cancels == 1 \
         and m.callback_errors == 1, m.snapshot()
-    return ("reject/timeout/cancel/error all observed, parity held "
+    from fleetx_tpu.obs import get_event_log
+
+    ev = get_event_log()
+    assert ev.find("queue_reject"), "reject left no structured event"
+    assert ev.find("request_timeout", request=rb), "timeout event missing"
+    assert ev.find("request_cancelled", request=rc), "cancel event missing"
+    assert ev.find("callback_error", request=rd), \
+        "callback-error event missing"
+    return ("reject/timeout/cancel/error all observed (each with its "
+            "structured event), parity held "
             f"(rejected={m.rejected} timeouts={m.timeouts} "
             f"cancels={m.cancels} callback_errors={m.callback_errors})")
 
@@ -305,8 +324,14 @@ def scenario_serving_recovery(tmp):
         if paged:
             eng.cache_manager.pool.check_invariants()
         recov.append(eng.metrics.engine_recoveries)
+    from fleetx_tpu.obs import get_event_log
+
+    ev = get_event_log()
+    assert len(ev.find("engine_recovery")) == 2, \
+        "each recovery must bank an engine_recovery event"
+    assert len(ev.find("tick_fault")) == 2, "tick faults unbanked"
     return ("tick-raise recovered byte-identically on slot AND paged paths "
-            f"(engine_recoveries={recov})")
+            f"(engine_recoveries={recov}, events banked)")
 
 
 def scenario_serving_poison(tmp):
@@ -331,8 +356,15 @@ def scenario_serving_poison(tmp):
     eng.cache_manager.pool.check_invariants()
     m = eng.metrics
     assert m.poison_retired == 1, m.snapshot()
-    return (f"poison request quarantined with partial tokens after "
-            f"{m.engine_recoveries} recoveries; 3 neighbors byte-identical")
+    from fleetx_tpu.obs import get_event_log
+
+    poison = get_event_log().find("poison_retired")
+    assert len(poison) == 1 and poison[0].attrs["request"] == rids[1], (
+        "poison quarantine must bank a poison_retired event naming the "
+        f"culprit request; got {poison}")
+    return (f"poison request {rids[1]} quarantined with partial tokens "
+            f"(event banked) after {m.engine_recoveries} recoveries; "
+            "3 neighbors byte-identical")
 
 
 def scenario_serving_hang(tmp):
@@ -355,8 +387,12 @@ def scenario_serving_hang(tmp):
     assert eng.hang_diagnostics is not None, "diagnostics not banked"
     assert eng.metrics.engine_recoveries >= 1
     assert all(np.array_equal(a, b) for a, b in zip(clean, faulty))
-    return ("hung tick abandoned at 0.3s, diagnostics banked, recovery "
-            "kept byte parity")
+    from fleetx_tpu.obs import get_event_log
+
+    assert get_event_log().find("tick_timeout"), \
+        "watchdog left no tick_timeout event"
+    return ("hung tick abandoned at 0.3s, diagnostics + tick_timeout "
+            "event banked, recovery kept byte parity")
 
 
 def scenario_serving_drain(tmp):
@@ -381,8 +417,14 @@ def scenario_serving_drain(tmp):
     except ShuttingDown:
         pass
     assert eng.metrics.drain_rejects == 1
+    from fleetx_tpu.obs import get_event_log
+
+    ev = get_event_log()
+    assert ev.find("shutdown"), "drain left no shutdown event"
+    assert ev.find("drain_reject"), "drain reject left no event"
     return (f"shutdown returned {len(res)}/{len(rids)} requests "
-            f"({partials} with partial tokens); admission rejected")
+            f"({partials} with partial tokens); admission rejected; "
+            "shutdown + drain_reject events banked")
 
 
 SCENARIOS = {
@@ -414,6 +456,12 @@ def main(argv=None) -> int:
             failures += 1
             continue
         try:
+            # each scenario asserts on the structured event log — start it
+            # empty so a previous scenario's events can't satisfy (or
+            # pollute) this one's expectations
+            from fleetx_tpu.obs import get_event_log
+
+            get_event_log().clear()
             detail = fn(os.path.join(tmp, name.strip()))
             print(f"PASS {name}: {detail}")
         except Exception as e:  # noqa: BLE001 — report, don't crash the sweep

@@ -1,0 +1,109 @@
+"""Dump the observability layer's expositions to files.
+
+Two sources (docs/OBSERVABILITY.md):
+
+- ``--url http://host:port`` — scrape a live process's exposition
+  server (``FLEETX_OBS_PORT``): writes ``metrics.prom`` (Prometheus
+  text), ``snapshot.json`` (registry + events + health), and
+  ``trace.json`` (Chrome-trace of the host span ring buffer — load in
+  chrome://tracing or Perfetto, or merge next to a jax profiler trace).
+- no ``--url`` — dump THIS process's in-memory registry/events/spans
+  (the in-process path library code uses:
+  ``from tools.obs_dump import dump_all``).
+
+Usage::
+
+    python tools/obs_dump.py --url http://127.0.0.1:9100 --out-dir obs/
+    python tools/obs_dump.py --out-dir obs/   # current process
+
+Exit is non-zero when the scrape fails — a cron'd dump must not rot
+silently.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_FILES = {
+    # endpoint path -> (filename, is_json)
+    "/metrics": ("metrics.prom", False),
+    "/snapshot": ("snapshot.json", True),
+    "/trace": ("trace.json", True),
+}
+
+
+def _fetch(url: str, timeout_s: float) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return resp.read()
+
+
+def dump_url(base_url: str, out_dir: str, timeout_s: float = 10.0) -> dict:
+    """Scrape ``base_url``'s three exposition endpoints into ``out_dir``;
+    returns {endpoint: written path}."""
+    os.makedirs(out_dir, exist_ok=True)
+    written = {}
+    for path, (fname, is_json) in _FILES.items():
+        body = _fetch(base_url.rstrip("/") + path, timeout_s)
+        if is_json:
+            json.loads(body)  # fail loudly on a broken payload
+        dst = os.path.join(out_dir, fname)
+        with open(dst, "wb") as f:
+            f.write(body)
+        written[path] = dst
+    return written
+
+
+def dump_all(out_dir: str) -> dict:
+    """Dump the CURRENT process's registry/events/spans into ``out_dir``
+    (same three files as :func:`dump_url`); returns {endpoint: path}."""
+    from fleetx_tpu.obs import get_recorder, get_registry
+    from fleetx_tpu.obs.http import snapshot_payload
+
+    os.makedirs(out_dir, exist_ok=True)
+    payloads = {
+        "/metrics": get_registry().prometheus_text().encode(),
+        # the exact /snapshot endpoint payload — shared builder, no drift
+        "/snapshot": json.dumps(snapshot_payload()).encode(),
+        "/trace": json.dumps(get_recorder().chrome_trace()).encode(),
+    }
+    written = {}
+    for path, body in payloads.items():
+        dst = os.path.join(out_dir, _FILES[path][0])
+        with open(dst, "wb") as f:
+            f.write(body)
+        written[path] = dst
+    return written
+
+
+def main(argv=None) -> int:
+    """CLI entry (module docstring); 0 on success."""
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", default=None,
+                    help="base URL of a live FLEETX_OBS_PORT server "
+                         "(omit to dump this process's own state)")
+    ap.add_argument("--out-dir", default="obs_dump",
+                    help="directory for metrics.prom / snapshot.json / "
+                         "trace.json")
+    ap.add_argument("--timeout-s", type=float, default=10.0,
+                    help="per-request scrape timeout")
+    args = ap.parse_args(argv)
+    try:
+        written = (dump_url(args.url, args.out_dir, args.timeout_s)
+                   if args.url else dump_all(args.out_dir))
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"obs_dump: FAILED: {type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    for path, dst in sorted(written.items()):
+        print(f"obs_dump: {path} -> {dst}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
