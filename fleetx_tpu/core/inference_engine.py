@@ -6,7 +6,15 @@ TPU-native: rebuild the flax module from the exported config, restore
 params, AOT-compile the forward (and the generation loop when a
 ``Generation`` section was exported) with jax.jit over an optional mesh —
 GSPMD replaces the reference's per-rank model dirs + comm-init CSV, and XLA
-is the optimizing backend where the reference plugs TensorRT."""
+is the optimizing backend where the reference plugs TensorRT.
+
+``FLEETX_SERVING_WEIGHT_DTYPE=int8`` serves this artifact weight-only-PTQ
+(docs/QUANTIZATION.md): params are quantized once at load
+(``ops/quant.quantize_tree_int8``, idempotent for quant-exported
+artifacts) and live in HBM as int8 + per-channel scales; ``predict()``
+dequantizes INSIDE its jit so XLA fuses the scale multiply into each
+matmul consumer, and the continuous-batching delegate engine reads the
+same env var and shares the same seam."""
 
 from __future__ import annotations
 
@@ -46,7 +54,30 @@ class InferenceEngine:
         self._gen_calls = 0  # folded into sampling keys: repeat calls differ
         gen = self.cfg.get("Generation") or {}
         self.eos_token_id = int(gen.get("eos_token_id") or 50256)
+        from fleetx_tpu.ops.quant import (
+            resolve_serving_dtype,
+            serving_weight_params,
+        )
+
+        # weight-only PTQ at load (no-op at bf16): HBM holds int8 +
+        # scales from here on; consumers dequantize at their jit boundary
+        # (module docstring)
+        self.weight_dtype = resolve_serving_dtype(
+            None, "FLEETX_SERVING_WEIGHT_DTYPE")
+        self.params = serving_weight_params(self.params, self.weight_dtype)
         logger.info("inference engine: %s from %s", module_name, export_dir)
+
+    def _float_params(self):
+        """Float view of the served params for non-jitted consumers (the
+        one-shot generate loop); a no-op at bf16. Dequantizes to the
+        module's compute dtype — not fp32 — so the temporary tree is no
+        larger than the unquantized original."""
+        if self.weight_dtype != "int8":
+            return self.params
+        from fleetx_tpu.ops.quant import dequantize_tree_int8
+
+        return dequantize_tree_int8(self.params,
+                                    dtype=self.module.nets.cfg.dtype)
 
     def _compile(self):
         if self._forward is not None:
@@ -59,6 +90,16 @@ class InferenceEngine:
                 "export has no default serving contract; use the module API "
                 "directly (predict() supports token-contract exports only)"
             )
+        if self.weight_dtype == "int8":
+            # dequant INSIDE the jit: the scale multiply fuses into each
+            # matmul consumer, HBM keeps the int8 tree
+            from fleetx_tpu.ops.quant import dequantize_tree_int8
+
+            base_fwd = fwd
+
+            def fwd(params, batch):
+                return base_fwd(dequantize_tree_int8(params), batch)
+
         if self.mesh is not None:
             # replicated params + dp-sharded batch over the provided mesh;
             # activation constraints inside the model resolve via the rules
@@ -140,7 +181,7 @@ class InferenceEngine:
                 ids, gcfg, rng=rng)
         run = lambda: generate(  # noqa: E731
             self.module.nets,
-            {"params": self.params},
+            {"params": self._float_params()},
             np.asarray(input_ids),
             gcfg,
             rng=rng,

@@ -105,6 +105,14 @@ class GPTConfig:
     # decode_cache_len a multiple of decode_page_size.
     decode_num_pages: Optional[int] = None
     decode_page_size: Optional[int] = None
+    # decode kv-cache precision: None keeps K/V at the compute dtype;
+    # "int8" stores both the slot cache and the paged pool as int8 with
+    # per-vector fp32 scales (ops/quant.quantize_kv) — ~2x tokens per HBM
+    # byte on the bandwidth-bound decode path. The flash-decode kernels
+    # dequantize in VMEM; dense fallbacks dequantize via the shared
+    # helper, so every attention path sees identical values
+    # (docs/QUANTIZATION.md; FLEETX_SERVING_KV_DTYPE wires it in serving).
+    decode_kv_dtype: Optional[str] = None
     # fuse the LM head matmul + cross-entropy into the Pallas blockwise
     # kernel (ops/pallas/ce_loss.py): the [tokens, vocab] logits never
     # materialize. Opt-in; intended for mp=1 runs (a vocab-sharded
@@ -200,7 +208,7 @@ class SelfAttention(nn.Module):
         causal = True
         if decode:
             kv_pad_mask = attn_mask  # pre-causal-merge mask: left-pad layout
-            k, v, attn_mask, decode_end, paged = self._update_cache(
+            k, v, attn_mask, decode_end, paged, kv_scales = self._update_cache(
                 k, v, attn_mask, cache_positions, block_tables
             )
             causal = False  # the cache mask encodes absolute-position causality
@@ -223,11 +231,24 @@ class SelfAttention(nn.Module):
                     out = flash_decode_paged_attention(
                         q, k, v, tables=tables, end=decode_end,
                         starts=self._pad_starts(kv_pad_mask, q.shape[0]),
+                        k_scale=kv_scales and kv_scales[0],
+                        v_scale=kv_scales and kv_scales[1],
                     )
                     out = checkpoint_name(out, "core_attn_out")
                     return self._out_proj(out)
                 k = paged_gather_kv(k, tables)
                 v = paged_gather_kv(v, tables)
+                if kv_scales is not None:
+                    # dense fallback over an int8 pool: gather each row's
+                    # scale pages through the same table, dequantize via
+                    # the shared helper (ops/quant.py)
+                    from fleetx_tpu.ops.quant import dequantize_kv
+
+                    k = dequantize_kv(
+                        k, paged_gather_kv(kv_scales[0], tables), q.dtype)
+                    v = dequantize_kv(
+                        v, paged_gather_kv(kv_scales[1], tables), q.dtype)
+                    kv_scales = None
             elif decode_end is not None and self._flash_decode_ok(
                 kv_pad_mask, k.shape[1], deterministic
             ):
@@ -242,9 +263,20 @@ class SelfAttention(nn.Module):
                 out = flash_decode_attention(
                     q, k, v, end=decode_end,
                     starts=self._pad_starts(kv_pad_mask, q.shape[0]),
+                    k_scale=kv_scales and kv_scales[0],
+                    v_scale=kv_scales and kv_scales[1],
                 )
                 out = checkpoint_name(out, "core_attn_out")
                 return self._out_proj(out)
+            if kv_scales is not None:
+                # contiguous dense fallback (prefill, custom masks, off-TPU)
+                # over the int8 slot cache: dequantize the full buffers via
+                # the shared helper — correctness paths cost what dense
+                # always cost, the flash path above never materializes this
+                from fleetx_tpu.ops.quant import dequantize_kv
+
+                k = dequantize_kv(k, kv_scales[0], q.dtype)
+                v = dequantize_kv(v, kv_scales[1], q.dtype)
 
         if cfg.cp_degree > 1 and not decode:
             # Ring attention: sequence stays sharded over the cp axis; KV
@@ -318,36 +350,72 @@ class SelfAttention(nn.Module):
         ``block_tables`` ([b, pages_per_row] int32) must come along with
         ``cache_positions`` — see :meth:`_update_paged_cache`.
 
-        Returns ``(k, v, attn_mask, decode_end, paged)``: ``decode_end`` is
-        the number of live cache positions after this step's write (the
-        single-query flash-decode kernel's upper bound; per-row [b] under
-        ``cache_positions``) — None during init and for multi-token
-        (prefill) calls, where the fast path does not apply. ``paged`` is
-        None on this contiguous layout (the paged branch returns the block
-        tables and RAW page pools instead of gathered buffers)."""
+        When ``cfg.decode_kv_dtype == "int8"`` the cache leaves store int8
+        values plus ``cached_key_scale``/``cached_value_scale`` fp32 leaves
+        of per-vector scales (``[..., max_len, nh, 1]``): this step's k/v
+        quantize on write via ``ops/quant.quantize_kv``, and the returned
+        buffers are the RAW int8 caches with ``kv_scales`` carrying the
+        scale buffers — the flash kernel dequantizes in VMEM, the dense
+        fallback dequantizes in the caller.
+
+        Returns ``(k, v, attn_mask, decode_end, paged, kv_scales)``:
+        ``decode_end`` is the number of live cache positions after this
+        step's write (the single-query flash-decode kernel's upper bound;
+        per-row [b] under ``cache_positions``) — None during init and for
+        multi-token (prefill) calls, where the fast path does not apply.
+        ``paged`` is None on this contiguous layout (the paged branch
+        returns the block tables and RAW page pools instead of gathered
+        buffers); ``kv_scales`` is None at the native kv dtype."""
         if self.cfg.decode_num_pages is not None:
             return self._update_paged_cache(
                 k, v, attn_mask, cache_positions, block_tables
             )
+        quant = self.cfg.decode_kv_dtype == "int8"
         is_init = not self.has_variable("cache", "cached_key")
         b, s, nh, hd = k.shape
         max_len = (self.cfg.decode_cache_len
                    if self.cfg.decode_cache_len is not None
                    else self.cfg.max_position_embeddings)
         ck = self.variable(
-            "cache", "cached_key", jnp.zeros, (b, max_len, nh, hd), k.dtype
+            "cache", "cached_key", jnp.zeros, (b, max_len, nh, hd),
+            jnp.int8 if quant else k.dtype
         )
         cv = self.variable(
-            "cache", "cached_value", jnp.zeros, (b, max_len, nh, hd), v.dtype
+            "cache", "cached_value", jnp.zeros, (b, max_len, nh, hd),
+            jnp.int8 if quant else v.dtype
         )
+        if quant:
+            # per-vector fp32 scales; the trailing 1 keeps the batch axis
+            # at -4 so scatter_slot and friends treat them like K/V leaves
+            cks = self.variable(
+                "cache", "cached_key_scale", jnp.zeros,
+                (b, max_len, nh, 1), jnp.float32
+            )
+            cvs = self.variable(
+                "cache", "cached_value_scale", jnp.zeros,
+                (b, max_len, nh, 1), jnp.float32
+            )
         idx = self.variable("cache", "cache_index", lambda: jnp.array(0, jnp.int32))
         decode_end = None
+        kv_scales = None
         if not is_init:
+            if quant:
+                from fleetx_tpu.ops.quant import quantize_kv
+
+                k_w, k_s = quantize_kv(k)
+                v_w, v_s = quantize_kv(v)
+            else:
+                k_w, v_w = k, v
             k_pos = jnp.arange(max_len)
             if cache_positions is None:
                 start = idx.value
-                ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, start, 0, 0))
-                cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, start, 0, 0))
+                ck.value = jax.lax.dynamic_update_slice(ck.value, k_w, (0, start, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(cv.value, v_w, (0, start, 0, 0))
+                if quant:
+                    cks.value = jax.lax.dynamic_update_slice(
+                        cks.value, k_s, (0, start, 0, 0))
+                    cvs.value = jax.lax.dynamic_update_slice(
+                        cvs.value, v_s, (0, start, 0, 0))
                 idx.value = start + s
                 if s == 1:
                     decode_end = idx.value
@@ -359,20 +427,25 @@ class SelfAttention(nn.Module):
                     lambda buf, new, p: jax.lax.dynamic_update_slice(
                         buf, new, (p, 0, 0))
                 )
-                ck.value = row_update(ck.value, k, wpos)
-                cv.value = row_update(cv.value, v, wpos)
+                ck.value = row_update(ck.value, k_w, wpos)
+                cv.value = row_update(cv.value, v_w, wpos)
+                if quant:
+                    cks.value = row_update(cks.value, k_s, wpos)
+                    cvs.value = row_update(cvs.value, v_s, wpos)
                 idx.value = jnp.max(wpos) + s
                 if s == 1:
                     decode_end = wpos + 1  # [b]: per-row live window end
                 q_pos = wpos[:, None] + jnp.arange(s)[None, :]  # [b, s]
                 causal = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None, :, :]
             k, v = ck.value, cv.value
+            if quant:
+                kv_scales = (cks.value, cvs.value)
             attn_mask = (
                 causal
                 if attn_mask is None
                 else (attn_mask.astype(bool) & causal)
             )
-        return k, v, attn_mask, decode_end, None
+        return k, v, attn_mask, decode_end, None, kv_scales
 
     def _update_paged_cache(self, k, v, attn_mask, cache_positions,
                             block_tables):
@@ -389,10 +462,15 @@ class SelfAttention(nn.Module):
         is built over LOGICAL positions, so the dense fallback can consume
         it after :func:`paged_gather_kv` unchanged.
 
-        Returns ``(k_pages, v_pages, attn_mask, decode_end, tables)``: raw
-        pools + tables so the caller picks paged-flash vs gather-dense
-        without materializing both."""
+        When ``cfg.decode_kv_dtype == "int8"`` the pools store int8 with
+        per-vector fp32 scale pools (``[num_pages, ps, nh, 1]``) scattered
+        through the same block tables — see :meth:`_update_cache`.
+
+        Returns ``(k_pages, v_pages, attn_mask, decode_end, tables,
+        kv_scales)``: raw pools + tables so the caller picks paged-flash
+        vs gather-dense without materializing both."""
         cfg = self.cfg
+        quant = cfg.decode_kv_dtype == "int8"
         is_init = not self.has_variable("cache", "cached_key")
         b, s, nh, hd = k.shape
         ps = cfg.decode_page_size
@@ -407,29 +485,54 @@ class SelfAttention(nn.Module):
                 f"decode_page_size {ps}")
         ck = self.variable(
             "cache", "cached_key", jnp.zeros,
-            (cfg.decode_num_pages, ps, nh, hd), k.dtype
+            (cfg.decode_num_pages, ps, nh, hd), jnp.int8 if quant else k.dtype
         )
         cv = self.variable(
             "cache", "cached_value", jnp.zeros,
-            (cfg.decode_num_pages, ps, nh, hd), v.dtype
+            (cfg.decode_num_pages, ps, nh, hd), jnp.int8 if quant else v.dtype
         )
+        if quant:
+            cks = self.variable(
+                "cache", "cached_key_scale", jnp.zeros,
+                (cfg.decode_num_pages, ps, nh, 1), jnp.float32
+            )
+            cvs = self.variable(
+                "cache", "cached_value_scale", jnp.zeros,
+                (cfg.decode_num_pages, ps, nh, 1), jnp.float32
+            )
         idx = self.variable("cache", "cache_index", lambda: jnp.array(0, jnp.int32))
         decode_end = None
         paged = None
+        kv_scales = None
         if not is_init:
             if cache_positions is None or block_tables is None:
                 raise ValueError(
                     "a paged decode cache needs cache_positions AND "
                     "block_tables (the serving engine threads both)")
+            if quant:
+                from fleetx_tpu.ops.quant import quantize_kv
+
+                k_w, k_s = quantize_kv(k)
+                v_w, v_s = quantize_kv(v)
+            else:
+                k_w, v_w = k, v
             wpos = cache_positions.astype(jnp.int32)       # [b] write offsets
             tables = block_tables.astype(jnp.int32)        # [b, n_pages_row]
             pos = wpos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
             pos = jnp.minimum(pos, max_len - 1)            # [b, s] logical
             page = jnp.take_along_axis(tables, pos // ps, axis=1)
             ck.value = ck.value.at[page.reshape(-1), (pos % ps).reshape(-1)
-                                   ].set(k.reshape(b * s, nh, hd))
+                                   ].set(k_w.reshape(b * s, nh, hd))
             cv.value = cv.value.at[page.reshape(-1), (pos % ps).reshape(-1)
-                                   ].set(v.reshape(b * s, nh, hd))
+                                   ].set(v_w.reshape(b * s, nh, hd))
+            if quant:
+                cks.value = cks.value.at[
+                    page.reshape(-1), (pos % ps).reshape(-1)
+                ].set(k_s.reshape(b * s, nh, 1))
+                cvs.value = cvs.value.at[
+                    page.reshape(-1), (pos % ps).reshape(-1)
+                ].set(v_s.reshape(b * s, nh, 1))
+                kv_scales = (cks.value, cvs.value)
             idx.value = jnp.max(wpos) + s
             if s == 1:
                 decode_end = wpos + 1  # [b]: per-row live logical length
@@ -440,7 +543,7 @@ class SelfAttention(nn.Module):
                          else attn_mask.astype(bool) & causal)
             paged = tables
             k, v = ck.value, cv.value
-        return k, v, attn_mask, decode_end, paged
+        return k, v, attn_mask, decode_end, paged, kv_scales
 
     def _flash_decode_ok(self, kv_pad_mask, cache_len: int,
                          deterministic: bool, tile_len: Optional[int] = None

@@ -31,6 +31,16 @@ What's different from the training kernel:
 - forward-only: decode never differentiates, so there is no VJP, no lse
   output, and no dropout plumbing.
 
+Int8 KV (``k_scale``/``v_scale`` given): K/V stream from HBM as int8 with
+one fp32 scale per cached (row, head) vector (``ops/quant.quantize_kv``
+layout, ``[..., cache_len, h, 1]`` scales). Dequantization happens in
+VMEM inside the same online-softmax body — ``int8 -> f32 * scale`` per
+resident tile, accumulator still fp32 — so the HBM bytes per decode step
+roughly halve (8-bit K/V + 4 bytes of scale per head vector) while the
+softmax math is bit-identical to dequantizing up front. The dense/XLA
+fallback uses the same ``dequantize_kv`` helper, keeping every path on
+one quantization contract (docs/QUANTIZATION.md).
+
 Paged variant (:func:`flash_decode_paged_attention`): the serving engine's
 page-granular cache stores K/V as ``[num_pages, page_size, h, d]`` shared
 pages and each batch row addresses its logical window through a block
@@ -115,14 +125,18 @@ def decode_flash_supported(cache_len: int) -> bool:
 
 def _decode_kernel(starts_ref, ends_ref, q_ref, k_ref, v_ref, o_ref,
                    m_scr, l_scr, acc_scr, *, block_k: int, major: int,
-                   scale: float):
+                   scale: float, ks_ref=None, vs_ref=None):
     """Grid step (batch bi, head hi, K/V major block jm): online-softmax
     update of the single query row against the live tiles of the resident
     major block.
 
     Every tile intersecting ``[start, end)`` runs masked — with one query
     row the mask is a [1, block_k] compare, noise next to the two dots, so
-    the training kernel's free/masked two-phase walk buys nothing here."""
+    the training kernel's free/masked two-phase walk buys nothing here.
+
+    ``ks_ref``/``vs_ref`` (int8 KV mode) are the per-vector fp32 scale
+    blocks riding the same index map as K/V; each resident tile is
+    dequantized in VMEM right before its dot product (module docstring)."""
     bi = pl.program_id(0)
     jm = pl.program_id(2)
     start = starts_ref[bi]
@@ -149,8 +163,17 @@ def _decode_kernel(starts_ref, ends_ref, q_ref, k_ref, v_ref, o_ref,
 
         def body(t, carry):
             m, l, acc = carry
-            k_blk = k_ref[pl.ds(t * block_k, block_k), :].astype(mm_dt)
-            v_blk = v_ref[pl.ds(t * block_k, block_k), :].astype(mm_dt)
+            k_blk = k_ref[pl.ds(t * block_k, block_k), :]
+            v_blk = v_ref[pl.ds(t * block_k, block_k), :]
+            if ks_ref is not None:
+                # dequant-in-VMEM: int8 tile * per-vector fp32 scale —
+                # [block_k, d] * [block_k, 1]; HBM only ever saw int8
+                k_blk = (k_blk.astype(jnp.float32)
+                         * ks_ref[pl.ds(t * block_k, block_k), :])
+                v_blk = (v_blk.astype(jnp.float32)
+                         * vs_ref[pl.ds(t * block_k, block_k), :])
+            k_blk = k_blk.astype(mm_dt)
+            v_blk = v_blk.astype(mm_dt)
             s = jax.lax.dot_general(
                 q, k_blk, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -186,6 +209,17 @@ def _decode_kernel(starts_ref, ends_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[:] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
 
 
+def _decode_kernel_q8(starts_ref, ends_ref, q_ref, k_ref, v_ref, ks_ref,
+                      vs_ref, o_ref, m_scr, l_scr, acc_scr, *, block_k: int,
+                      major: int, scale: float):
+    """Int8-KV grid step: the contiguous kernel body with the two scale
+    operands threaded in (they ride the K/V index map, so a dead block's
+    scales are as DMA-free as its values)."""
+    _decode_kernel(starts_ref, ends_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, block_k=block_k, major=major,
+                   scale=scale, ks_ref=ks_ref, vs_ref=vs_ref)
+
+
 def _kv_index_map(major: int):
     """K/V major-block index for grid step (bi, hi, jm): clamped into the
     live [first, last] range of THIS batch row, so dead steps repeat a
@@ -216,6 +250,8 @@ def flash_decode_attention(
     starts: Optional[jax.Array] = None,
     block_k: Optional[int] = None,
     block_major: Optional[int] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Single-query attention against the kv cache, [b, 1, h, d] layout.
 
@@ -226,12 +262,19 @@ def flash_decode_attention(
     attends exactly the window [starts[b], end). No scaling/softmax state
     leaves the kernel; output dtype follows ``q``.
 
+    ``k_scale``/``v_scale`` ([b, cache_len, h, 1] fp32, given together)
+    switch the kernel to int8-KV mode: ``k``/``v`` are int8 per
+    ``ops/quant.quantize_kv`` and each streamed tile is dequantized in
+    VMEM (module docstring).
+
     ``cache_len`` must be a multiple of 8 (checked; callers pre-screen with
     :func:`decode_flash_supported` and take the XLA path otherwise).
     """
     b, sq, h, d = q.shape
     if sq != 1:
         raise ValueError(f"flash decode is single-query (q_len={sq})")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("int8 KV needs BOTH k_scale and v_scale")
     cache_len = k.shape[1]
     block_k, major = fit_decode_blocks(cache_len, block_k, block_major)
     if block_k is None:
@@ -246,17 +289,27 @@ def flash_decode_attention(
 
     # grid (b, h, majors) over the NATIVE [b, s, h, d] layout — no
     # [b*h, s, d] repack, which would itself stream the full cache
-    kernel = functools.partial(
-        _decode_kernel, block_k=block_k, major=major, scale=1.0 / (d**0.5)
-    )
+    kv_spec = pl.BlockSpec((None, major, None, d), _kv_index_map(major))
+    in_specs = [pl.BlockSpec((None, 1, None, d), _q_index_map),
+                kv_spec, kv_spec]
+    operands = [q, k, v]
+    if k_scale is not None:
+        # scales ride the SAME clamped index map: a dead grid step repeats
+        # resident scale blocks exactly like resident K/V blocks (no DMA)
+        s_spec = pl.BlockSpec((None, major, None, 1), _kv_index_map(major))
+        in_specs += [s_spec, s_spec]
+        operands += [k_scale, v_scale]
+        kernel = functools.partial(
+            _decode_kernel_q8, block_k=block_k, major=major,
+            scale=1.0 / (d**0.5))
+    else:
+        kernel = functools.partial(
+            _decode_kernel, block_k=block_k, major=major,
+            scale=1.0 / (d**0.5))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, h, n_major),
-        in_specs=[
-            pl.BlockSpec((None, 1, None, d), _q_index_map),
-            pl.BlockSpec((None, major, None, d), _kv_index_map(major)),
-            pl.BlockSpec((None, major, None, d), _kv_index_map(major)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, 1, None, d), _q_index_map),
         scratch_shapes=[
             pltpu.VMEM((1, 1), jnp.float32),   # running max m
@@ -273,7 +326,7 @@ def flash_decode_attention(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=_interpret(),
-    )(starts_b, ends_b, q, k, v)
+    )(starts_b, ends_b, *operands)
 
 
 # ------------------------------------------------------------- paged variant
@@ -291,6 +344,18 @@ def _paged_decode_kernel(starts_ref, ends_ref, tables_ref, q_ref, k_ref,
     _decode_kernel(starts_ref, ends_ref, q_ref, k_ref, v_ref, o_ref,
                    m_scr, l_scr, acc_scr, block_k=block_k, major=page_size,
                    scale=scale)
+
+
+def _paged_decode_kernel_q8(starts_ref, ends_ref, tables_ref, q_ref, k_ref,
+                            v_ref, ks_ref, vs_ref, o_ref, m_scr, l_scr,
+                            acc_scr, *, block_k: int, page_size: int,
+                            scale: float):
+    """Int8-KV paged grid step: scale pages gather through the same block
+    table as the K/V pages, dequant happens tile-by-tile in VMEM."""
+    del tables_ref  # consumed by the index maps, not the body
+    _decode_kernel(starts_ref, ends_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, block_k=block_k, major=page_size,
+                   scale=scale, ks_ref=ks_ref, vs_ref=vs_ref)
 
 
 def _paged_kv_index_map(page_size: int):
@@ -336,6 +401,8 @@ def flash_decode_paged_attention(
     end: jax.Array,
     starts: Optional[jax.Array] = None,
     block_k: Optional[int] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Single-query attention against a PAGED kv cache.
 
@@ -347,6 +414,11 @@ def flash_decode_paged_attention(
     sharing prefix pages simply carry the same physical indices in their
     tables — the kernel reads shared pages like any other.
 
+    ``k_scale``/``v_scale`` ([num_pages, page_size, h, 1] fp32, given
+    together) switch to int8-KV mode: the pools are int8 per
+    ``ops/quant.quantize_kv`` and scale pages gather through the same
+    block table, dequantized in VMEM (module docstring).
+
     ``page_size`` must be a multiple of 8 (callers pre-screen with
     :func:`decode_flash_supported` on the page size); ``block_k`` tiles
     within a page (largest divisor wins, as in the contiguous kernel).
@@ -354,6 +426,8 @@ def flash_decode_paged_attention(
     b, sq, h, d = q.shape
     if sq != 1:
         raise ValueError(f"flash decode is single-query (q_len={sq})")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("int8 KV needs BOTH k_scale and v_scale")
     page_size = k_pages.shape[1]
     # major is pinned to one page (the gather unit); block_k tiles inside
     block_k, major = fit_decode_blocks(page_size, block_k, page_size)
@@ -368,20 +442,27 @@ def flash_decode_paged_attention(
                 else starts.astype(jnp.int32))
     tables_b = tables.astype(jnp.int32)
 
-    kernel = functools.partial(
-        _paged_decode_kernel, block_k=block_k, page_size=page_size,
-        scale=1.0 / (d**0.5)
-    )
+    kv_spec = pl.BlockSpec((None, page_size, None, d),
+                           _paged_kv_index_map(page_size))
+    in_specs = [pl.BlockSpec((None, 1, None, d), _paged_q_index_map),
+                kv_spec, kv_spec]
+    operands = [q, k_pages, v_pages]
+    if k_scale is not None:
+        s_spec = pl.BlockSpec((None, page_size, None, 1),
+                              _paged_kv_index_map(page_size))
+        in_specs += [s_spec, s_spec]
+        operands += [k_scale, v_scale]
+        kernel = functools.partial(
+            _paged_decode_kernel_q8, block_k=block_k, page_size=page_size,
+            scale=1.0 / (d**0.5))
+    else:
+        kernel = functools.partial(
+            _paged_decode_kernel, block_k=block_k, page_size=page_size,
+            scale=1.0 / (d**0.5))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b, h, n_logical),
-        in_specs=[
-            pl.BlockSpec((None, 1, None, d), _paged_q_index_map),
-            pl.BlockSpec((None, page_size, None, d),
-                         _paged_kv_index_map(page_size)),
-            pl.BlockSpec((None, page_size, None, d),
-                         _paged_kv_index_map(page_size)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, 1, None, d), _paged_q_index_map),
         scratch_shapes=[
             pltpu.VMEM((1, 1), jnp.float32),   # running max m
@@ -398,4 +479,4 @@ def flash_decode_paged_attention(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=_interpret(),
-    )(starts_b, ends_b, tables_b, q, k_pages, v_pages)
+    )(starts_b, ends_b, tables_b, *operands)

@@ -39,6 +39,18 @@ The scalar ``cache_index`` leaves inside the cache tree are unused on
 the serving path (per-row progress lives in ``lengths``; the model
 receives explicit ``cache_positions`` instead) — see
 ``SelfAttention._update_cache``.
+
+Quantized storage (``decode_kv_dtype="int8"`` on the model config, wired
+by ``FLEETX_SERVING_KV_DTYPE``; docs/QUANTIZATION.md): the cache tree
+built by ``init_decode_cache`` then carries int8 K/V leaves plus fp32
+``cached_key_scale``/``cached_value_scale`` leaves of per-vector scales.
+Nothing in this module special-cases them — the scale leaves share the
+K/V leaves' trailing-rank layout (``[..., lanes|pages, len, heads, 1]``),
+so :func:`scatter_slot` slots them by the same rank-≥4 rule and the page
+lifecycle (trash-page routing, no-zeroing, refcounts) is dtype-blind:
+a page's scales travel with its values because both are indexed by the
+same block table. :meth:`_LaneBook.cache_nbytes` measures the actual
+device bytes either way, which is how the ~2× HBM win is asserted.
 """
 
 from __future__ import annotations
@@ -114,6 +126,15 @@ class _LaneBook:
         self.request_ids[slot] = None
         self.lengths[slot] = 0
         heapq.heappush(self._free, slot)
+
+    def cache_nbytes(self) -> int:
+        """Device bytes of the live cache tree, measured from the actual
+        leaves (int8 values + fp32 scales when kv-quantized, full-width
+        K/V otherwise) — the scrapeable ground truth for the quantized
+        HBM story (``fleetx_serving_kv_cache_bytes``)."""
+        return sum(
+            int(leaf.size) * np.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree.leaves(self.cache))
 
 
 class SlotKVCacheManager(_LaneBook):

@@ -34,6 +34,20 @@ slots ride the batched step with their writes pinned to the last cache
 row and their outputs discarded; a freed slot's stale K/V is never
 attended (see ``cache_manager.py``).
 
+Quantized serving (docs/QUANTIZATION.md): ``FLEETX_SERVING_KV_DTYPE=int8``
+stores decode K/V (slot cache or paged pool) as int8 with per-vector fp32
+scales — quantize-on-write in ``SelfAttention._update_cache``, dequant in
+VMEM inside the flash-decode kernels — roughly halving the HBM bytes the
+bandwidth-bound decode tick moves (and the pages a cached token pins).
+``FLEETX_SERVING_WEIGHT_DTYPE=int8`` serves weight-only-PTQ params: the
+tree is quantized once at construction (``ops/quant.quantize_tree_int8``)
+and dequantized INSIDE the jitted prefill/decode calls, so XLA fuses the
+scale multiply into each matmul consumer and HBM holds int8 + scales.
+Replay recovery re-prefills through the same jitted seams, so crash
+safety is precision-agnostic. Both knobs default off ("bf16" = the model
+compute dtype), and the default path stays byte-identical; quantized
+configs trade byte parity for a documented token/logit tolerance.
+
 Unsupported request shapes (beam search, repetition penalty, forced
 EOS/BOS) raise at construction/submit — they need cross-step state the
 slot loop does not carry; use the one-shot ``generate()`` for those.
@@ -243,7 +257,9 @@ class ServingEngine:
                  prefix_cache: Optional[bool] = None,
                  max_recoveries: Optional[int] = None,
                  tick_timeout_s: Optional[float] = None,
-                 grace_s: Optional[float] = None):
+                 grace_s: Optional[float] = None,
+                 kv_dtype: Optional[str] = None,
+                 weight_dtype: Optional[str] = None):
         gen_cfg = gen_cfg or GenerationConfig(decode_strategy="greedy")
         if gen_cfg.repetition_penalty != 1.0:
             raise ValueError("continuous batching does not support "
@@ -269,6 +285,16 @@ class ServingEngine:
             # fast path engages; the extra rows are never attended
             cache_len += -cache_len % 8
         self.cache_len = cache_len
+        # quantized serving (module docstring): kv int8 halves decode HBM
+        # traffic + pages per cached token; weight int8 halves/quarters
+        # servable-param HBM. "bf16" = the model's native compute dtype.
+        from fleetx_tpu.ops.quant import resolve_serving_dtype
+
+        self.kv_dtype = resolve_serving_dtype(
+            kv_dtype, "FLEETX_SERVING_KV_DTYPE")
+        self.weight_dtype = resolve_serving_dtype(
+            weight_dtype, "FLEETX_SERVING_WEIGHT_DTYPE")
+        decode_kv = "int8" if self.kv_dtype == "int8" else None
         if self.paged:
             # default pool = the slot cache's capacity in pages + the
             # reserved trash page; short requests then leave pages free
@@ -283,16 +309,26 @@ class ServingEngine:
             self.model = model.clone(cfg=dataclasses.replace(
                 model.cfg, decode_cache_len=cache_len,
                 decode_num_pages=self.num_pages,
-                decode_page_size=self.page_size))
+                decode_page_size=self.page_size,
+                decode_kv_dtype=decode_kv))
         else:
             self.num_pages = 0
             self.prefix_cache = False
             self.model = model.clone(cfg=dataclasses.replace(
                 model.cfg, decode_cache_len=cache_len,
-                decode_num_pages=None, decode_page_size=None))
+                decode_num_pages=None, decode_page_size=None,
+                decode_kv_dtype=decode_kv))
         self.params = (variables["params"]
                        if isinstance(variables, dict) and "params" in variables
                        else variables)
+        # weight-only PTQ once, up front (no-op at bf16): servable params
+        # live in HBM as int8 + per-channel scales; every jitted prefill/
+        # decode call dequantizes INSIDE the jit (_dequant_params), so
+        # XLA fuses the scale multiply into the matmul consumers.
+        # Idempotent for pre-quantized trees (InferenceEngine).
+        from fleetx_tpu.ops.quant import serving_weight_params
+
+        self.params = serving_weight_params(self.params, self.weight_dtype)
         self.topk_cap = topk_cap or _env_int("FLEETX_SERVING_TOPK_CAP", 64)
         self.prefill_bucket = (prefill_bucket
                                or _env_int("FLEETX_SERVING_PREFILL_BUCKET", 32))
@@ -342,6 +378,7 @@ class ServingEngine:
         self._tables_version = -1     # refreshed when the manager's moves
         self.scheduler = FIFOScheduler()
         self.metrics = metrics or ServingMetrics(self.slots)
+        self._publish_quant_metrics()
         self._base_key = jax.random.PRNGKey(base_seed)
         self._next_id = 0
         self._ticks = 0
@@ -1071,6 +1108,35 @@ class ServingEngine:
             "rng": jnp.zeros((s, 2), jnp.uint32),
         }
 
+    def _dequant_params(self, params):
+        """Weight-only-int8 dequant seam, called INSIDE every jitted
+        prefill/decode body: a no-op at bf16; at int8 it re-expands the
+        {"_q8", "_scale"} leaves so XLA fuses the scale multiply into
+        each matmul consumer — HBM holds the int8 tree, the float view
+        is a fusion-local temporary."""
+        if self.weight_dtype != "int8":
+            return params
+        from fleetx_tpu.ops.quant import dequantize_tree_int8
+
+        return dequantize_tree_int8(params, dtype=jnp.float32)
+
+    def _publish_quant_metrics(self) -> None:
+        """Push the precision config + bytes gauges into the metrics
+        facade (labels kv_dtype/weight_dtype; docs/OBSERVABILITY.md).
+        Re-call after swapping ``engine.metrics`` (the bench does)."""
+        cfg = self.model.cfg
+        kv_item = 1 if self.kv_dtype == "int8" else jnp.dtype(cfg.dtype).itemsize
+        # K + V bytes one cached token costs across every layer, scales
+        # included (one fp32 scale per head vector at int8)
+        kv_bytes = cfg.num_layers * cfg.num_attention_heads * 2 * (
+            cfg.head_dim * kv_item + (4 if self.kv_dtype == "int8" else 0))
+        weight_bytes = sum(
+            int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree.leaves(self.params))
+        self.metrics.set_quant_config(
+            self.kv_dtype, self.weight_dtype, kv_bytes, weight_bytes,
+            kv_cache_bytes=self.cache_manager.cache_nbytes())
+
     def _admit_fn(self, st, slot, tok, length, decoded, active, eos, max_new,
                   min_new, greedy, temperature, top_k, top_p, key):
         """Jitted: install one request's scalars into slot ``slot`` of the
@@ -1121,6 +1187,7 @@ class ServingEngine:
 
         def prefill(params, cache, prompt, true_len, slot, eos, min_new,
                     greedy, temperature, top_k, top_p, key):
+            params = self._dequant_params(params)
             ids = prompt[None, :]
             # right-pad bucket tail: causal masking keeps the tail out of
             # every position <= true_len-1, and its K/V rows sit beyond the
@@ -1155,6 +1222,7 @@ class ServingEngine:
 
         def prefill(params, cache, suffix, true_len, wpos, table, eos,
                     min_new, greedy, temperature, top_k, top_p, key):
+            params = self._dequant_params(params)
             ids = suffix[None, :]
             # absolute positions wpos.. for the suffix; the right-pad
             # bucket tail is causally invisible and its writes land beyond
@@ -1345,6 +1413,7 @@ class ServingEngine:
         (None on the slot path). ``all_greedy`` is static — greedy-only
         ticks take a bare argmax and skip the sampler's top-k sort /
         top-p bisection / rng split."""
+        params = self._dequant_params(params)
         active = st["active"]
         lengths = st["lengths"]
         max_pos = self.model.cfg.max_position_embeddings

@@ -141,6 +141,27 @@ class ServingMetrics:
         self._g_pages_total = gauge(
             "fleetx_serving_pages_total",
             "Usable KV pages in the shared pool (paged mode)")
+        # quantized-serving config (docs/QUANTIZATION.md): the info-style
+        # family carries the active precision pair as labels; the bytes
+        # gauges make the HBM win scrapeable next to tokens/s
+        self._quant_family = reg.gauge(
+            "fleetx_serving_quant_config",
+            "1 at the engine's active (kv_dtype, weight_dtype) pair",
+            ("engine", "kv_dtype", "weight_dtype"))
+        self._g_kv_bytes = gauge(
+            "fleetx_serving_kv_bytes_per_token",
+            "KV-cache bytes one cached token costs across all layers "
+            "(per-vector scales included at int8)")
+        self._g_weight_bytes = gauge(
+            "fleetx_serving_weight_bytes",
+            "Bytes of servable params resident in HBM "
+            "(int8 values + scales when weight-quantized)")
+        self._g_kv_cache_bytes = gauge(
+            "fleetx_serving_kv_cache_bytes",
+            "Device bytes of the whole decode cache tree, measured from "
+            "its actual leaves (values + scale leaves)")
+        self.kv_dtype = "bf16"
+        self.weight_dtype = "bf16"
         self._h_ttft = hist(
             "fleetx_serving_ttft_seconds",
             "Submit-to-first-token latency (end-to-end, host observed)")
@@ -224,6 +245,23 @@ class ServingMetrics:
         self._c_prefill_saved.inc(int(shared_tokens))
         self._c_prompt_tokens.inc(int(prompt_tokens))
         self._h_pages_per_req.observe(int(pages))
+
+    def set_quant_config(self, kv_dtype: str, weight_dtype: str,
+                         kv_bytes_per_token: int, weight_bytes: int,
+                         kv_cache_bytes: int = 0) -> None:
+        """Publish the engine's precision config: the (kv_dtype,
+        weight_dtype) info labels plus the bytes-per-token / param-bytes /
+        cache-tree gauges the HBM story is read from
+        (docs/QUANTIZATION.md)."""
+        self.kv_dtype = kv_dtype
+        self.weight_dtype = weight_dtype
+        labels = {"engine": self.engine_label, "kv_dtype": kv_dtype,
+                  "weight_dtype": weight_dtype}
+        self._owned.append((self._quant_family, dict(labels)))
+        self._quant_family.labels(**labels).set(1)
+        self._g_kv_bytes.set(int(kv_bytes_per_token))
+        self._g_weight_bytes.set(int(weight_bytes))
+        self._g_kv_cache_bytes.set(int(kv_cache_bytes))
 
     def observe_pages(self, pages_in_use: int, pages_total: int) -> None:
         """Per-tick page-pool gauge sample (paged mode only)."""
@@ -444,6 +482,13 @@ class ServingMetrics:
             "pages_total": self.pages_total,
             "page_occupancy_mean": (self._h_page_occ.mean or 0.0),
             "page_occupancy_peak": (self._h_page_occ.max or 0.0),
+            # precision story (docs/QUANTIZATION.md): what the decode path
+            # stores K/V and weights as, and what that costs in HBM
+            "kv_dtype": self.kv_dtype,
+            "weight_dtype": self.weight_dtype,
+            "kv_bytes_per_token": int(self._g_kv_bytes.value),
+            "weight_bytes": int(self._g_weight_bytes.value),
+            "kv_cache_bytes": int(self._g_kv_cache_bytes.value),
             # crash-safety story: how often the engine recovered, what it
             # quarantined, what shutdown turned away, and what a tick costs
             "engine_recoveries": self.engine_recoveries,

@@ -80,6 +80,29 @@ def test_wikitext_ppl_cli(tmp_path, byte_vocab):
     assert "ppl" in r.stdout.lower()
 
 
+def test_int8_weight_ppl_within_budget(tmp_path, byte_vocab):
+    """The quality half of the quantized-serving acceptance gate
+    (docs/QUANTIZATION.md): weight-only int8 PTQ through
+    ``Offline_Eval.weight_dtype`` must move WikiText perplexity by less
+    than the documented 2% relative budget — and must actually move it
+    (a zero delta would mean the quantization never engaged)."""
+    corpus = tmp_path / "wiki.txt"
+    corpus.write_text("the quick brown fox jumps over the lazy dog. " * 60)
+    cfg_path = _eval_cfg(tmp_path, str(corpus), "False", byte_vocab)
+
+    sys.path.insert(0, REPO)
+    import tools.eval as ev
+    from fleetx_tpu.utils.config import get_config
+
+    fp = ev.offline_eval(get_config(cfg_path, show=False))
+    qcfg = get_config(cfg_path, show=False)
+    qcfg.Offline_Eval.weight_dtype = "int8"
+    q8 = ev.offline_eval(qcfg)
+    assert q8["tokens"] == fp["tokens"]
+    rel = abs(q8["ppl"] - fp["ppl"]) / fp["ppl"]
+    assert 0 < rel < 0.02, (fp["ppl"], q8["ppl"], rel)
+
+
 def test_lambada_cloze_cli(tmp_path, byte_vocab):
     data = tmp_path / "lambada.jsonl"
     data.write_text(

@@ -30,7 +30,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from fleetx_tpu.models.gpt.generation import GenerationConfig, generate
+from serving_parity import assert_token_parity, one_shot_tokens
+
+from fleetx_tpu.models.gpt.generation import GenerationConfig
 from fleetx_tpu.models.gpt.model import GPTConfig, GPTForPretraining
 from fleetx_tpu.serving import PagedKVCacheManager, PagePool, ServingEngine
 
@@ -68,14 +70,9 @@ def _engine(model, params, **kw):
 
 
 def _one_shot_tokens(model, params, prompt, max_length, eos=10**6):
-    cfg = dataclasses.replace(GREEDY, max_length=max_length,
-                              eos_token_id=eos)
-    out = np.asarray(generate(model, params, jnp.asarray(prompt[None]),
-                              cfg))[0]
-    gen = out[len(prompt):]
-    if eos in gen.tolist():
-        gen = gen[:gen.tolist().index(eos) + 1]
-    return gen
+    """tests/serving_parity.py reference bound to this suite's GREEDY."""
+    return one_shot_tokens(model, params, prompt, max_length,
+                           gen_cfg=GREEDY, eos=eos)
 
 
 # ------------------------------------------------------- PagePool host units
@@ -285,10 +282,10 @@ def test_paged_vs_slot_staggered_parity(model_and_params):
     _, slot_toks = run(paged=False)
     for i, p in enumerate(prompts):
         want = _one_shot_tokens(model, params, p, 4)
-        np.testing.assert_array_equal(paged_toks[i], want,
-                                      err_msg=f"paged vs one-shot, req {i}")
-        np.testing.assert_array_equal(slot_toks[i], want,
-                                      err_msg=f"slot vs one-shot, req {i}")
+        assert_token_parity(paged_toks[i], want,
+                            err_msg=f"paged vs one-shot, req {i}")
+        assert_token_parity(slot_toks[i], want,
+                            err_msg=f"slot vs one-shot, req {i}")
     assert paged_eng.cache_manager.pages_in_use == 0  # all chains returned
     assert paged_eng.cache_manager.free_count == 2
 
@@ -309,7 +306,7 @@ def test_prefix_reuse_cuts_prefill_and_pages(model_and_params):
     rids = [eng.submit(p, max_length=4) for p in prompts]
     res = eng.drain()
     for i, p in enumerate(prompts):
-        np.testing.assert_array_equal(
+        assert_token_parity(
             res[rids[i]].tokens, _one_shot_tokens(model, params, p, 4),
             err_msg=f"req {i}")
     snap = eng.metrics.snapshot()
@@ -341,8 +338,8 @@ def test_page_granular_admission(model_and_params):
     assert summary["admitted"] == 4  # all four live despite the tiny pool
     res = eng.drain()
     for rid, p in zip(rids, prompts):
-        np.testing.assert_array_equal(
-            res[rid].tokens, _one_shot_tokens(model, params, p, 7))
+        assert_token_parity(res[rid].tokens,
+                            _one_shot_tokens(model, params, p, 7))
     assert eng.cache_manager.pages_in_use == 0
 
 

@@ -14,6 +14,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from serving_parity import assert_token_parity, one_shot_tokens
+
 from fleetx_tpu.models.gpt.generation import GenerationConfig, generate
 from fleetx_tpu.models.gpt.model import GPTConfig, GPTForPretraining
 from fleetx_tpu.serving import ServingEngine, ServingMetrics, SlotKVCacheManager
@@ -50,15 +52,10 @@ def _engine(model, params, **kw):
 
 
 def _one_shot_tokens(model, params, prompt, max_length, eos=10**6):
-    """Reference: per-request one-shot generate(), trimmed at EOS."""
-    cfg = dataclasses.replace(GREEDY, max_length=max_length,
-                              eos_token_id=eos)
-    out = np.asarray(generate(model, params, jnp.asarray(prompt[None]),
-                              cfg))[0]
-    gen = out[len(prompt):]
-    if eos in gen.tolist():
-        gen = gen[:gen.tolist().index(eos) + 1]
-    return gen
+    """Reference: per-request one-shot generate(), trimmed at EOS (the
+    shared tests/serving_parity.py harness bound to this suite's GREEDY)."""
+    return one_shot_tokens(model, params, prompt, max_length,
+                           gen_cfg=GREEDY, eos=eos)
 
 
 # --------------------------------------------------- the acceptance parity
@@ -85,8 +82,8 @@ def test_staggered_mixed_length_parity(model_and_params):
     assert len(results) == 8
     for rid, (p, g) in rids.items():
         want = _one_shot_tokens(model, params, p, g)
-        np.testing.assert_array_equal(
-            results[rid].tokens, want, err_msg=f"request {rid}")
+        assert_token_parity(results[rid].tokens, want,
+                            err_msg=f"request {rid}")
         assert results[rid].finish_reason == "max_length"
     snap = eng.metrics.snapshot()
     assert snap["retired"] == 8 and snap["submitted"] == 8
@@ -110,9 +107,9 @@ def test_eos_retirement_frees_slot_and_matches_one_shot(model_and_params):
     r2 = eng.submit(p2, max_length=5)  # queued behind r1's slot
     res = eng.drain()
     assert res[r1].finish_reason == "eos"
-    np.testing.assert_array_equal(
+    assert_token_parity(
         res[r1].tokens, _one_shot_tokens(model, params, p1, 8, eos=eos))
-    np.testing.assert_array_equal(
+    assert_token_parity(
         res[r2].tokens, _one_shot_tokens(model, params, p2, 5))
     assert eng.cache_manager.free_count == 1  # slot cycled back
     assert eng.metrics.snapshot()["finish_reasons"] == {
@@ -132,8 +129,8 @@ def test_slot_reuse_many_requests_few_slots(model_and_params):
         reqs[eng.submit(p, max_length=4)] = p
     res = eng.drain()
     for rid, p in reqs.items():
-        np.testing.assert_array_equal(
-            res[rid].tokens, _one_shot_tokens(model, params, p, 4))
+        assert_token_parity(res[rid].tokens,
+                            _one_shot_tokens(model, params, p, 4))
     assert eng.metrics.snapshot()["retired"] == 9
     assert eng.cache_manager.free_count == 2
 
@@ -155,8 +152,8 @@ def test_flash_decode_per_slot_windows(model_and_params, monkeypatch):
         reqs[eng.submit(p, max_length=6)] = p
     res = eng.drain()
     for rid, p in reqs.items():
-        np.testing.assert_array_equal(
-            res[rid].tokens, _one_shot_tokens(dense_model, params, p, 6))
+        assert_token_parity(res[rid].tokens,
+                            _one_shot_tokens(dense_model, params, p, 6))
 
 
 # ------------------------------------------------ per-request decode knobs

@@ -18,6 +18,8 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from serving_parity import assert_token_parity
+
 from fleetx_tpu.models.gpt.generation import GenerationConfig
 from fleetx_tpu.models.gpt.model import GPTConfig, GPTForPretraining
 from fleetx_tpu.resilience.faults import faults
@@ -107,7 +109,7 @@ def test_tick_raise_rollback_and_replay_parity(tiny, paged):
     assert eng.metrics.engine_recoveries == 1
     assert eng.metrics.snapshot()["engine_recoveries"] == 1
     for i in clean:
-        np.testing.assert_array_equal(clean[i], faulty[i])
+        assert_token_parity(clean[i], faulty[i])
 
 
 @pytest.mark.parametrize("paged", [False, True], ids=["slot", "paged"])
@@ -125,7 +127,7 @@ def test_manual_recover_is_byte_identical(tiny, paged):
     res = eng.drain()
     _check_pool(eng)
     for i, r in enumerate(rids):
-        np.testing.assert_array_equal(clean[i], np.asarray(res[r].tokens))
+        assert_token_parity(clean[i], np.asarray(res[r].tokens))
 
 
 def test_sampling_replay_reconstructs_rng_stream(tiny):
@@ -140,7 +142,7 @@ def test_sampling_replay_reconstructs_rng_stream(tiny):
                        fault_kw=dict(tick_raise="2"))
     assert eng.metrics.engine_recoveries == 1
     for i in clean:
-        np.testing.assert_array_equal(clean[i], faulty[i])
+        assert_token_parity(clean[i], faulty[i])
 
 
 def test_failed_tick_leaves_pre_tick_state(tiny):
@@ -169,7 +171,7 @@ def test_failed_tick_leaves_pre_tick_state(tiny):
         faults.reset()
     clean = _clean(tiny, True)
     for i, r in enumerate(rids):
-        np.testing.assert_array_equal(clean[i], np.asarray(res[r].tokens))
+        assert_token_parity(clean[i], np.asarray(res[r].tokens))
 
 
 @pytest.mark.parametrize("paged", [False, True], ids=["slot", "paged"])
@@ -192,7 +194,7 @@ def test_poison_request_bisection_neighbor_parity(tiny, paged):
     assert eng.metrics.poison_retired == 1
     assert eng.metrics.snapshot()["poison_retired"] == 1
     for i in (0, 2, 3):
-        np.testing.assert_array_equal(clean[i],
+        assert_token_parity(clean[i],
                                       np.asarray(res[rids[i]].tokens))
 
 
@@ -214,7 +216,7 @@ def test_poison_prefill_quarantined_without_bisection(tiny):
     clean = _clean(tiny, True)
     rid2 = eng.submit(PROMPTS[0], max_length=8)
     res2 = eng.drain()
-    np.testing.assert_array_equal(clean[0], np.asarray(res2[rid2].tokens))
+    assert_token_parity(clean[0], np.asarray(res2[rid2].tokens))
 
 
 @pytest.mark.parametrize("paged", [False, True], ids=["slot", "paged"])
@@ -239,7 +241,7 @@ def test_hung_tick_watchdog_recovers(tiny, paged):
     assert eng.metrics.engine_recoveries >= 1
     _check_pool(eng)
     for i, r in enumerate(rids):
-        np.testing.assert_array_equal(clean[i], np.asarray(res[r].tokens))
+        assert_token_parity(clean[i], np.asarray(res[r].tokens))
 
 
 def test_recovery_exhausted_raises(tiny):
@@ -289,7 +291,7 @@ def test_shutdown_with_grace_finishes_short_requests(tiny):
     res = eng.shutdown(grace_s=60.0)
     for i, r in enumerate(rids):
         assert res[r].finish_reason == "max_length"
-        np.testing.assert_array_equal(clean[i], np.asarray(res[r].tokens))
+        assert_token_parity(clean[i], np.asarray(res[r].tokens))
 
 
 def test_sigterm_requests_drain(tiny):
@@ -342,7 +344,7 @@ def test_shared_prefix_replay_keeps_trie_sharing(tiny):
     assert eng.metrics.engine_recoveries == 1
     assert eng.metrics.snapshot()["prefix_hits"] >= 2
     for a, b in zip(clean, faulty):
-        np.testing.assert_array_equal(a, b)
+        assert_token_parity(a, b)
 
 
 def test_tick_wallclock_metrics_present(tiny):

@@ -73,13 +73,16 @@ def test_precompute_text_embeddings_hash(tmp_path):
 
 
 def test_bench_serving_records_schema(monkeypatch):
-    """Serving bench on the tiny CPU config: static, continuous, and
-    shared-prefix modes all produce finite throughput records with the
-    documented schema, continuous tokens are byte-identical to static's
-    (detail.parity — the bench doubles as a scheduling-only comparison),
-    and the shared-prefix mode's warm pass reports the prefix-reuse
-    counters (hit rate, prefill tokens saved, page occupancy)."""
+    """Serving bench on the tiny CPU config: static, continuous,
+    shared-prefix, faulted, int8, and (env-gated) page-sweep modes all
+    produce finite throughput records with the documented schema,
+    continuous tokens are byte-identical to static's (detail.parity —
+    the bench doubles as a scheduling-only comparison), the shared-prefix
+    warm pass reports the prefix-reuse counters, the int8 record carries
+    the precision/HBM comparison fields with tolerance parity asserted,
+    and each swept page size stays byte-identical."""
     monkeypatch.setenv("BENCH_SERVING_TINY", "1")
+    monkeypatch.setenv("BENCH_SERVING_PAGE_SIZES", "8,16")
     sys.path.insert(0, REPO)
     import tools.bench_serving as bs
 
@@ -88,8 +91,9 @@ def test_bench_serving_records_schema(monkeypatch):
     assert [r["metric"] for r in recs] == [
         "gpt_345m_serving_static", "gpt_345m_serving_continuous",
         "gpt_345m_serving_shared_prefix", "gpt_345m_serving_faulted",
+        "gpt_345m_serving_int8", "gpt_345m_serving_page_sweep",
     ]
-    static, cont, shared, faulted = recs
+    static, cont, shared, faulted, int8, sweep = recs
     for r in recs:
         assert r["unit"] == "tokens/s"
         assert np.isfinite(r["value"]) and r["value"] > 0
@@ -121,6 +125,30 @@ def test_bench_serving_records_schema(monkeypatch):
     assert d["poison_retired"] == 0
     assert 0 <= d["recovery_overhead_frac"] < 1
     assert d["tick_ms_p50"] > 0 and d["tick_ms_p99"] >= d["tick_ms_p50"]
+    # the int8 record: precision labels, measured HBM halving, decode
+    # cost-model bytes both ways, and tolerance parity (>= 75% leading
+    # tokens vs bf16 — asserted inside serving_records too)
+    d = int8["detail"]
+    assert d["parity"] is True and d["parity_prefix_frac_min"] >= 0.75
+    assert d["kv_dtype"] == "int8" and d["weight_dtype"] == "int8"
+    assert 0 < d["kv_cache_bytes"] < 0.5 * d["kv_cache_bytes_bf16"]
+    assert 0 < d["kv_bytes_per_token"] < d["kv_bytes_per_token_bf16"]
+    assert 0 < d["weight_bytes"] < d["weight_bytes_bf16"]
+    assert d["speedup_vs_bf16"] > 0
+    # cost-model decode bytes: measurable on the CPU XLA path too, but
+    # the int8 < bf16 ordering is a FLASH-path (TPU) claim — the CPU
+    # dense fallback materializes dequantized f32 copies, so here we
+    # only pin that both precisions were measured
+    assert d["decode_bytes_per_token_int8"] is None or (
+        d["decode_bytes_per_token_int8"] > 0)
+    assert d["decode_bytes_per_token_bf16"] is None or (
+        d["decode_bytes_per_token_bf16"] > 0)
+    # the page sweep ran both sizes byte-identically and picked a winner
+    d = sweep["detail"]
+    assert d["parity"] is True
+    assert [s["page_size"] for s in d["sweep"]] == [8, 16]
+    assert d["best_page_size"] in (8, 16)
+    assert all(s["tokens_per_s"] > 0 for s in d["sweep"])
 
 
 @pytest.mark.slow  # 9.8s on the slow-host baseline (PR 7 tier-1 budget audit)

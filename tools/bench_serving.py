@@ -30,6 +30,23 @@ reports tokens/s next to the clean run (`recovery_overhead_frac`), the
 recovery count, and tick p50/p99 — resilience cost in the perf
 trajectory, with byte parity vs the clean run asserted.
 
+A fifth record (`int8`) runs the QUANTIZED serving path
+(docs/QUANTIZATION.md): the same continuous workload through an engine
+with int8 KV cache + int8 weight-only params. It reports tokens/s next
+to the bf16 run (`speedup_vs_bf16`), the measured cache/param HBM bytes,
+the XLA cost-model bytes one decode tick moves per lane for BOTH
+precisions (`decode_bytes_per_token*` — the bandwidth claim, from
+`Compiled.cost_analysis()`), and asserts the tolerance-parity contract:
+every request's stream must share at least 75% of its leading tokens
+with the bf16 run (`parity` + `parity_prefix_frac_min`; byte parity is
+deliberately NOT required — that is the bf16 contract).
+
+`BENCH_SERVING_PAGE_SIZES=16,32,64` appends a page-size sweep record
+(`page_sweep`): the continuous workload re-run per page size so a TPU
+window can pick a DMA-tuned default over the correctness-tuned 16
+(ROADMAP item 1 follow-up); per-size tokens/s + TTFT ride
+`detail.sweep`, `value` is the best size's tokens/s.
+
 Standalone:  python tools/bench_serving.py
 In-process:  from tools.bench_serving import serving_records
 """
@@ -108,6 +125,26 @@ def _shared_prefix_workload(n: int):
     return out
 
 
+def _decode_bytes_per_token(engine):
+    """XLA cost-model bytes one jitted decode tick accesses, per decode
+    lane (= per token at full occupancy) — the HBM-bandwidth claim the
+    int8 record makes, measured on the COMPILED step, not estimated.
+    None when the backend's cost analysis has no byte accounting."""
+    try:
+        compiled = engine._decode_jit.lower(
+            engine.params, engine.cache_manager.cache, engine._state,
+            engine._device_tables(), True).compile()
+        cost = compiled.cost_analysis()
+        # jax-version skew: one dict on newer jax, [dict] on older
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        if not cost or cost.get("bytes accessed") is None:
+            return None
+        return round(float(cost["bytes accessed"]) / engine.slots, 1)
+    except Exception:  # cost model is best-effort, never fails the bench
+        return None
+
+
 def _ttft_stats(ttfts_s):
     arr = np.asarray(ttfts_s, np.float64) * 1e3
     return {
@@ -184,6 +221,7 @@ def _run_continuous(engine, workload):
     from fleetx_tpu.serving.metrics import ServingMetrics
 
     engine.metrics = ServingMetrics(engine.slots)  # fresh gauges per run
+    engine._publish_quant_metrics()  # fresh gauges need the precision info
     t0 = time.perf_counter()
     rids = [engine.submit(p, max_length=g) for p, g in workload]
     res = engine.drain()
@@ -301,6 +339,49 @@ def serving_records(n_requests: int = N_REQUESTS, slots: int = SLOTS):
     fault_detail["recovery_overhead_frac"] = round(
         max(1.0 - fault_tps / clean_tps, 0.0), 3)
 
+    # int8 mode: the full quantized serving path (int8 KV + int8 weights)
+    # on the same workload; the comparison vs the bf16 continuous record
+    # is the precision lever's price/win sheet (docs/QUANTIZATION.md)
+    int8_engine = ServingEngine(model, variables, slots=slots,
+                                cache_len=model.cfg.max_position_embeddings,
+                                gen_cfg=gen_cfg,
+                                prefill_bucket=8 if _TINY else 32,
+                                kv_dtype="int8", weight_dtype="int8")
+    _run_continuous(int8_engine, workload)  # compile warmup
+    int8_toks, _, int8_detail = _run_continuous(int8_engine, workload)
+    # tolerance parity, not byte parity: every stream must share its
+    # leading tokens with the bf16 run up to the documented budget —
+    # ops/quant owns BOTH the number and the measure (length mismatch =
+    # outright fail), so this gate cannot drift from the test harness's;
+    # byte-identity is the bf16 records' gate
+    from fleetx_tpu.ops.quant import QUANT_PREFIX_BUDGET, quant_parity_frac
+
+    need = 1.0 - QUANT_PREFIX_BUDGET
+    fracs = [quant_parity_frac(a, b) for a, b in zip(int8_toks, cont_toks)]
+    int8_detail["parity_prefix_frac_min"] = round(min(fracs), 3)
+    int8_detail["parity"] = min(fracs) >= need
+    assert int8_detail["parity"], (
+        f"int8 serving diverged from bf16 beyond the tolerance contract: "
+        f"min leading-token agreement {min(fracs):.3f} < {need}")
+    snap = int8_engine.metrics.snapshot()
+    bf16_snap = cont_detail["obs_snapshot"]
+    int8_detail.update({
+        "kv_dtype": snap["kv_dtype"],
+        "weight_dtype": snap["weight_dtype"],
+        "kv_bytes_per_token": snap["kv_bytes_per_token"],
+        "kv_bytes_per_token_bf16": bf16_snap["kv_bytes_per_token"],
+        "kv_cache_bytes": snap["kv_cache_bytes"],
+        "kv_cache_bytes_bf16": bf16_snap["kv_cache_bytes"],
+        "weight_bytes": snap["weight_bytes"],
+        "weight_bytes_bf16": bf16_snap["weight_bytes"],
+        # XLA cost-model bytes per decode lane per tick, both precisions:
+        # the bandwidth-bound-path claim, from the compiled step itself
+        "decode_bytes_per_token_int8": _decode_bytes_per_token(int8_engine),
+        "decode_bytes_per_token_bf16": _decode_bytes_per_token(engine),
+    })
+    int8_tps = int8_detail["useful_tokens"] / int8_detail["elapsed_s"]
+    int8_detail["speedup_vs_bf16"] = round(int8_tps / clean_tps, 3)
+
     # shared-prefix mode: paged engine, trie-cold warmup then warm timing
     sp_workload = _shared_prefix_workload(n_requests)
     sp_engine = ServingEngine(model, variables, slots=slots,
@@ -319,11 +400,47 @@ def serving_records(n_requests: int = N_REQUESTS, slots: int = SLOTS):
     sp_detail["prefix_len"] = PREFIX_LEN
 
     device = getattr(jax.devices()[0], "device_kind", "?")
+    modes = [("static", static_detail),
+             ("continuous", cont_detail),
+             ("shared_prefix", sp_detail),
+             ("faulted", fault_detail),
+             ("int8", int8_detail)]
+
+    # page-size sweep (ROADMAP item 1 follow-up): opt-in via
+    # BENCH_SERVING_PAGE_SIZES so a TPU window can pick a DMA-tuned
+    # default; each size re-runs the continuous workload byte-identically
+    sweep_env = os.environ.get("BENCH_SERVING_PAGE_SIZES", "")
+    if sweep_env.strip():
+        sweep, per_size_detail = [], {}
+        for ps in (int(s) for s in sweep_env.split(",") if s.strip()):
+            eng = ServingEngine(model, variables, slots=slots,
+                                cache_len=model.cfg.max_position_embeddings,
+                                gen_cfg=gen_cfg, paged=True, page_size=ps,
+                                prefill_bucket=8 if _TINY else 32)
+            _run_continuous(eng, workload)  # compile warmup
+            toks, _, d = _run_continuous(eng, workload)
+            assert all(np.array_equal(a, b)
+                       for a, b in zip(toks, cont_toks)), (
+                f"page_size={ps} broke greedy byte parity")
+            per_size_detail[ps] = d
+            sweep.append({
+                "page_size": ps,
+                "tokens_per_s": round(d["useful_tokens"] / d["elapsed_s"], 1),
+                "ttft_ms_p50": d["ttft_ms_p50"],
+                "ttft_ms_p95": d["ttft_ms_p95"],
+                "page_occupancy_mean": d.get("page_occupancy_mean"),
+            })
+        best = max(sweep, key=lambda r: r["tokens_per_s"])
+        # the record's standard fields come from the winning size's timed
+        # pass; the full per-size table rides detail.sweep
+        sweep_detail = per_size_detail[best["page_size"]]
+        sweep_detail["sweep"] = sweep
+        sweep_detail["best_page_size"] = best["page_size"]
+        sweep_detail["parity"] = True  # asserted per size above
+        modes.append(("page_sweep", sweep_detail))
+
     records = []
-    for mode, detail in (("static", static_detail),
-                         ("continuous", cont_detail),
-                         ("shared_prefix", sp_detail),
-                         ("faulted", fault_detail)):
+    for mode, detail in modes:
         detail["device"] = device
         records.append({
             "metric": f"gpt_345m_serving_{mode}",

@@ -7,6 +7,16 @@ Two modes:
   ``eval_path`` — raw text / jsonl (needs ``vocab_dir``) or pre-tokenized
   ``.npy``.
 - otherwise: mean CE loss over the config's Data.Eval loader.
+
+``Offline_Eval.weight_dtype: int8`` (or ``-o
+Offline_Eval.weight_dtype=int8``) scores the weight-only-PTQ model the
+quantized serving path deploys: params round-trip through the exact
+``quantize_tree_int8`` → ``dequantize_tree_int8`` pair the serving
+engines use, so the reported ppl/acc IS the served int8 model's quality
+— the eval half of the docs/QUANTIZATION.md tolerance contract (the
+token-level half is tests/serving_parity.py). KV-cache quantization has
+no teacher-forced analogue (no decode cache is read here); its quality
+is covered by the token-parity budget.
 """
 
 import json
@@ -102,10 +112,28 @@ def offline_eval(cfg):
                 "eval: no restorable checkpoint under ckpt_dir "
                 f"{cfg.Engine.save_load.ckpt_dir!r} — evaluating unrestored "
                 "params would report a meaningless loss")
-    result = module.evaluate_dataset(
-        trainer.state.params, _batched(ds, batch_size)
+    from fleetx_tpu.ops.quant import (
+        dequantize_tree_int8,
+        resolve_serving_dtype,
+        serving_weight_params,
     )
-    logger.info("offline eval (%s): %s", module.eval_type, result)
+
+    try:
+        weight_dtype = resolve_serving_dtype(
+            oe.get("weight_dtype"), None, label="Offline_Eval.weight_dtype")
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
+    params = trainer.state.params
+    if weight_dtype == "int8":
+        # the serving path's weight-only PTQ, applied verbatim: this eval
+        # measures the model ServingEngine/InferenceEngine actually run
+        params = dequantize_tree_int8(
+            serving_weight_params(params, weight_dtype))
+        logger.info("offline eval: weight-only int8 PTQ applied "
+                    "(docs/QUANTIZATION.md)")
+    result = module.evaluate_dataset(params, _batched(ds, batch_size))
+    logger.info("offline eval (%s%s): %s", module.eval_type,
+                " int8" if weight_dtype == "int8" else "", result)
     return result
 
 
