@@ -344,7 +344,12 @@ class SelfAttention(nn.Module):
         ``cache_positions[b]`` and attends the per-row causal window
         ``[0, cache_positions[b] + s)``. The scalar ``cache_index`` is still
         advanced (to the max write end) so one-shot callers interleaving
-        both styles stay consistent.
+        both styles stay consistent. Multi-token calls (s > 1) with
+        ``cache_positions`` are the CHUNKED-prefill seam: successive calls
+        at increasing offsets write a prompt's K/V incrementally, and the
+        absolute-position causal mask keeps each chunk's queries reading
+        exactly the prefix earlier chunks wrote — byte-identical to one
+        whole-prompt call (docs/SERVING.md chunked prefill).
 
         When ``cfg.decode_num_pages`` is set the cache is page-granular and
         ``block_tables`` ([b, pages_per_row] int32) must come along with

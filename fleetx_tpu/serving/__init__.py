@@ -25,6 +25,7 @@ observability). docs/SERVING.md has the architecture tour.
 """
 
 from fleetx_tpu.serving.cache_manager import (
+    HostPageStore,
     PagedKVCacheManager,
     PagePool,
     SlotKVCacheManager,
@@ -49,6 +50,7 @@ __all__ = [
     "ServingResult",
     "ShuttingDown",
     "TickTimeout",
+    "HostPageStore",
     "PagePool",
     "PagedKVCacheManager",
     "SlotKVCacheManager",

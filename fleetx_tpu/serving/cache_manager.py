@@ -51,18 +51,111 @@ lifecycle (trash-page routing, no-zeroing, refcounts) is dtype-blind:
 a page's scales travel with its values because both are indexed by the
 same block table. :meth:`_LaneBook.cache_nbytes` measures the actual
 device bytes either way, which is how the ~2× HBM win is asserted.
+
+Two-level page cache (``FLEETX_SERVING_HOST_CACHE_BYTES``;
+docs/SERVING.md): with a :class:`HostPageStore` attached, LRU eviction
+of a zero-ref warm trie subtree SPILLS each page's content (K/V and, at
+int8, the scale pages — every cache leaf) to bounded host DRAM instead
+of destroying it. Entries are keyed by the page's full token-chunk path
+from the trie root, so they are content-addressed: a later prompt
+carrying the same prefix revives them into fresh physical pages via one
+batched device transfer per cache leaf, an engine ``recover()`` that
+rebuilds the pool from scratch still matches them (the engine re-threads
+the same store), and a stale entry can never be wrong — deterministic
+prefill means identical tokens produce identical K/V. The pool stays
+pure-host: the actual device reads/writes go through ``spill_fn`` /
+``revive_fn`` callbacks the :class:`PagedKVCacheManager` binds (tests
+drive the pool with dummy payloads, no backend needed).
 """
 
 from __future__ import annotations
 
 import heapq
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
-__all__ = ["PagePool", "PagedKVCacheManager", "SlotKVCacheManager",
-           "scatter_slot"]
+__all__ = ["HostPageStore", "PagePool", "PagedKVCacheManager",
+           "SlotKVCacheManager", "scatter_slot"]
+
+
+class HostPageStore:
+    """Bounded host-DRAM spill tier for KV pages (module docstring).
+
+    A byte-budgeted LRU dict: ``key`` is a page's full token-chunk path
+    (tuple of full-page token tuples from the trie root) and the payload
+    is whatever the spilling manager handed over (per-leaf host arrays).
+    Keys are content-addressed, so the store outlives any one
+    :class:`PagePool`/:class:`PagedKVCacheManager` — the engine owns the
+    store and re-threads it through ``recover()``'s rebuilt manager.
+    Capacity pressure drops the OLDEST entries (counted in
+    ``evicted_pages``); a payload larger than the whole budget is
+    rejected outright. Pure host state, no locking (the serving engine
+    is single-threaded per replica)."""
+
+    def __init__(self, capacity_bytes: int):
+        if capacity_bytes < 0:
+            raise ValueError(
+                f"capacity_bytes must be >= 0, got {capacity_bytes}")
+        self.capacity_bytes = int(capacity_bytes)
+        self._entries: Dict[tuple, Tuple[object, int]] = {}  # insertion=LRU
+        self.nbytes = 0
+        self.spilled_pages = 0  # lifetime puts accepted
+        self.revived_pages = 0  # lifetime pops on a prefix match
+        self.evicted_pages = 0  # lifetime drops (capacity pressure)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        return key in self._entries
+
+    def put(self, key, payload, nbytes: int) -> bool:
+        """Insert one spilled page, evicting oldest entries until it
+        fits; False (nothing stored) when ``nbytes`` exceeds the whole
+        budget. Re-putting a key refreshes its payload and LRU slot."""
+        if nbytes > self.capacity_bytes:
+            return False
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self.nbytes -= old[1]
+        while self.nbytes + nbytes > self.capacity_bytes and self._entries:
+            k = next(iter(self._entries))
+            self.nbytes -= self._entries.pop(k)[1]
+            self.evicted_pages += 1
+        self._entries[key] = (payload, nbytes)
+        self.nbytes += nbytes
+        self.spilled_pages += 1
+        return True
+
+    def get(self, key):
+        """A matched page's payload for revival, refreshing its LRU
+        slot. The entry STAYS — the tier is inclusive: the device gets a
+        copy, and a fault that destroys the device copy (rollback,
+        recovery, re-eviction) can revive this entry again. A later
+        re-spill of the same path overwrites it with identical bytes
+        (content-addressed keys cannot go stale). KeyError if absent."""
+        payload, nbytes = self._entries.pop(key)
+        self._entries[key] = (payload, nbytes)  # re-insert = LRU refresh
+        self.revived_pages += 1
+        return payload
+
+    def pop(self, key):
+        """Remove and return an entry's payload (explicit invalidation;
+        the revive path uses :meth:`get`). KeyError if absent."""
+        payload, nbytes = self._entries.pop(key)
+        self.nbytes -= nbytes
+        return payload
+
+    def check_invariants(self) -> None:
+        """Byte accounting must match the entries exactly and respect
+        the budget (called from :meth:`PagePool.check_invariants`)."""
+        want = sum(nb for _, nb in self._entries.values())
+        assert self.nbytes == want, (
+            f"host store nbytes {self.nbytes} != sum of entries {want}")
+        assert self.nbytes <= self.capacity_bytes, (
+            f"host store over budget: {self.nbytes} > {self.capacity_bytes}")
 
 
 def scatter_slot(cache, prefill_cache, slot):
@@ -210,7 +303,10 @@ class PagePool:
     no scan of the pool."""
 
     def __init__(self, num_pages: int, page_size: int, lanes: int,
-                 lane_pages: int, prefix_cache: bool = True):
+                 lane_pages: int, prefix_cache: bool = True,
+                 host_store: Optional[HostPageStore] = None,
+                 spill_fn: Optional[Callable] = None,
+                 revive_fn: Optional[Callable] = None):
         if page_size < 1:
             raise ValueError(f"page_size must be positive, got {page_size}")
         if num_pages < lane_pages + 1:
@@ -237,6 +333,14 @@ class PagePool:
         # bumped on every block-table change so the engine re-uploads the
         # device copy only when something moved
         self.version = 0
+        # host spill tier (module docstring): active only when all three
+        # pieces are present AND the trie is on (spilled entries are
+        # matched by token-chunk path — without the trie nothing could
+        # ever revive them)
+        self.host_store = (host_store if prefix_cache and spill_fn
+                           and revive_fn else None)
+        self._spill_fn = spill_fn
+        self._revive_fn = revive_fn
 
     # ------------------------------------------------------------- stats
 
@@ -287,7 +391,8 @@ class PagePool:
 
     def _take_page(self) -> Optional[int]:
         """Pop a free page; when the stack is dry, evict the LRU cached
-        prefix subtree (all refcount-0 by the parent>=child invariant)."""
+        prefix subtree (all refcount-0 by the parent>=child invariant) —
+        spilling its pages to the host tier first when one is attached."""
         if not self._free:
             if not self._cached:
                 return None
@@ -295,18 +400,59 @@ class PagePool:
             self._evict_subtree(node)
         return self._free.pop()
 
+    @staticmethod
+    def _node_key(node: _TrieNode) -> tuple:
+        """A node's full token-chunk path from the root — the content
+        address its spilled payload is stored under."""
+        parts = []
+        while node is not None and node.key is not None:
+            parts.append(node.key)
+            node = node.parent
+        return tuple(reversed(parts))
+
     def _evict_subtree(self, node: _TrieNode) -> None:
+        """Reclaim a zero-ref cached subtree's physical pages. With a
+        host tier attached, each page's content is spilled (ONE batched
+        device read for the whole subtree) before the page frees; the
+        warm data then survives as host entries revivable by token path.
+        Without one, this is plain destruction (the pre-spill behavior).
+        """
         if node.parent is not None:
             del node.parent.children[node.key]
+        victims: List[_TrieNode] = []
         stack = [node]
         while stack:
             n = stack.pop()
             stack.extend(n.children.values())
+            victims.append(n)
+        if self.host_store is not None and victims:
+            keys = [self._node_key(n) for n in victims]
+            for (payload, nbytes), key in zip(
+                    self._spill_fn([n.page for n in victims]), keys):
+                self.host_store.put(key, payload, nbytes)
+        for n in victims:
             self._cached.pop(n.page, None)
             del self._node_of_page[n.page]
             self._free.append(n.page)
             n.children = {}
             n.parent = None
+
+    def _match_host(self, chunks: List[tuple],
+                    path: List[_TrieNode]) -> List[tuple]:
+        """Continue a trie prefix match into the host spill tier: the
+        chunk paths extending ``path`` that have spilled payloads. Stops
+        at the first miss (a revived page is only attendable if every
+        page before it is present too)."""
+        if self.host_store is None:
+            return []
+        key = self._node_key(path[-1]) if path else ()
+        out = []
+        for c in chunks[len(path):]:
+            key = key + (c,)
+            if key not in self.host_store:
+                break
+            out.append(key)
+        return out
 
     # ----------------------------------------------------------- requests
 
@@ -331,10 +477,14 @@ class PagePool:
 
     def alloc(self, lane: int, tokens) -> Optional[int]:
         """Build ``lane``'s block table for prompt ``tokens``: shared
-        prefix pages from the trie (refcount++) plus fresh refcount-1
-        pages covering the rest of ``[0, prompt_len]``. Returns the shared
-        prefix length in TOKENS (0 = no reuse), or None — with no state
-        committed — when the pool cannot supply the fresh pages."""
+        prefix pages from the trie (refcount++), host-spilled prefix
+        pages revived into fresh physical pages (one batched device
+        write), plus fresh refcount-1 pages covering the rest of
+        ``[0, prompt_len]``. Returns the shared prefix length in TOKENS —
+        trie-shared AND host-revived pages both skip their prefill — or
+        None, with no state committed, when the pool cannot supply the
+        physical pages (host revivals draw from the same free pool as
+        fresh claims, so :meth:`pages_needed` already counts them)."""
         if self.alloc_counts[lane]:
             raise ValueError(f"lane {lane} already holds pages")
         need_total = len(tokens) // self.page_size + 1
@@ -353,25 +503,47 @@ class PagePool:
             if self.ref[n.page] == 0:
                 del self._cached[n.page]
             self.ref[n.page] += 1
-        fresh = need_total - len(path)
+        fresh = need_total - len(path)  # incl. any host-revived pages
         if fresh > self.free_pages:
             for n in reversed(path):  # unwind: nothing committed
                 self.ref[n.page] -= 1
                 if self.ref[n.page] == 0:
                     self._cached[n.page] = n
             return None
+        # grab matched host payloads BEFORE drawing pages: a draw can
+        # trigger more spills, and the store's capacity pressure could
+        # evict an entry this alloc is about to revive (the local
+        # reference keeps the payload alive either way — the tier is
+        # inclusive, see HostPageStore.get)
+        host_keys = self._match_host(chunks, path)
+        payloads = [self.host_store.get(k) for k in host_keys]
         row = self.tables[lane]
         row[:] = 0
         for i, n in enumerate(path):
             row[i] = n.page
-        for i in range(len(path), need_total):
+        parent = path[-1] if path else self._root
+        revive = []
+        for j, key in enumerate(host_keys):
+            # revived pages re-enter the trie as regular registered pages
+            # (refcount 1, shareable immediately) at fresh physical homes
+            page = self._take_page()
+            self.ref[page] = 1
+            row[len(path) + j] = page
+            node = _TrieNode(key[-1], page, parent)
+            parent.children[key[-1]] = node
+            self._node_of_page[page] = node
+            parent = node
+            revive.append((page, payloads[j]))
+        for i in range(len(path) + len(host_keys), need_total):
             page = self._take_page()
             self.ref[page] = 1
             row[i] = page
+        if revive:
+            self._revive_fn(revive)
         self.alloc_counts[lane] = need_total
-        self.shared_counts[lane] = len(path)
+        self.shared_counts[lane] = len(path) + len(host_keys)
         self.version += 1
-        return len(path) * self.page_size
+        return (len(path) + len(host_keys)) * self.page_size
 
     def register_prefix(self, lane: int, tokens) -> None:
         """Insert ``lane``'s freshly-prefilled FULL prompt pages into the
@@ -462,6 +634,12 @@ class PagePool:
                     f"trie child page {c.page} (ref {self.ref[c.page]}) "
                     f"outlives parent {n.page} (ref {self.ref[n.page]})")
                 stack.append(c)
+        # host tier: byte accounting exact, and no key shadows a LIVE trie
+        # path (a spilled entry for a path that is back in the trie is
+        # merely stale-but-valid — content-addressed keys cannot be wrong
+        # — but the trie must win the match, so it never revives)
+        if self.host_store is not None:
+            self.host_store.check_invariants()
 
     def free(self, lane: int) -> None:
         """Release every page of ``lane``'s chain (refcount--). Zero-ref
@@ -503,7 +681,8 @@ class PagedKVCacheManager(_LaneBook):
     moves."""
 
     def __init__(self, model, slots: int, cache_len: int, num_pages: int,
-                 page_size: int, prefix_cache: bool = True):
+                 page_size: int, prefix_cache: bool = True,
+                 host_store: Optional[HostPageStore] = None):
         from fleetx_tpu.models.gpt.generation import init_decode_cache
 
         if page_size % 8:
@@ -527,9 +706,87 @@ class PagedKVCacheManager(_LaneBook):
         self.cache_len = cache_len
         self.page_size = page_size
         self.num_pages = num_pages
+        self.host_store = host_store
+        self._revive_jit = self._make_revive_jit()
         self.pool = PagePool(num_pages, page_size, slots,
-                             cache_len // page_size, prefix_cache)
+                             cache_len // page_size, prefix_cache,
+                             host_store=host_store,
+                             spill_fn=self._spill_pages,
+                             revive_fn=self._revive_pages)
         self.cache = init_decode_cache(model, slots)
+
+    # ------------------------------------------------------ host spill tier
+
+    def _spill_pages(self, pages: List[int]) -> List[Tuple[list, int]]:
+        """Read ``pages`` out of the device pool as host payloads — one
+        batched gather + transfer per cache leaf for the whole list (the
+        subtree being evicted), not one per page. A payload is the
+        per-leaf list of that page's slices (K, V, and the int8 scale
+        pages when quantized); rank-<4 leaves (``cache_index`` scalars)
+        ride as None."""
+        import jax.numpy as jnp
+
+        from fleetx_tpu.obs.events import emit as obs_emit
+
+        idx = jnp.asarray(pages, jnp.int32)
+        per_leaf = []
+        for leaf in jax.tree.leaves(self.cache):
+            if leaf.ndim < 4:
+                per_leaf.append(None)
+                continue
+            ax = leaf.ndim - 4  # the page axis (scan-stacked or unrolled)
+            taken = jnp.moveaxis(jnp.take(leaf, idx, axis=ax), ax, 0)
+            per_leaf.append(np.asarray(jax.device_get(taken)))
+        out = []
+        for j in range(len(pages)):
+            payload = [None if a is None else a[j] for a in per_leaf]
+            nbytes = sum(a.nbytes for a in payload if a is not None)
+            out.append((payload, nbytes))
+        obs_emit("page_spill", pages=len(pages))
+        return out
+
+    def _make_revive_jit(self):
+        """Jitted batched revival: one scatter per cache leaf, with the
+        old pool buffers DONATED on TPU so XLA updates the pages in
+        place — an eager ``.at[].set`` would copy every full-size pool
+        leaf per revival, transiently doubling the cache's HBM footprint
+        the engine's donation discipline exists to avoid. jax.jit's own
+        shape-keyed cache gives one compile per distinct batch size
+        (bounded by lane_pages, like the engine's prefill buckets)."""
+
+        def revive(leaves, pages, updates):
+            out = []
+            for leaf, upd in zip(leaves, updates):
+                ax = leaf.ndim - 4
+                index = (slice(None),) * ax + (pages,)
+                out.append(leaf.at[index].set(upd))
+            return out
+
+        donate = jax.default_backend() in ("tpu", "axon")
+        return jax.jit(revive, donate_argnums=(0,) if donate else ())
+
+    def _revive_pages(self, entries: List[Tuple[int, list]]) -> None:
+        """Write spilled payloads back into fresh physical ``pages`` —
+        one batched host→device transfer + in-place scatter per cache
+        leaf for every page an alloc revives (the "batched device_put"
+        the revive path promises)."""
+        import jax.numpy as jnp
+
+        from fleetx_tpu.obs.events import emit as obs_emit
+
+        pages = jnp.asarray([p for p, _ in entries], jnp.int32)
+        leaves, treedef = jax.tree.flatten(self.cache)
+        big = [i for i, leaf in enumerate(leaves) if leaf.ndim >= 4]
+        updates = [
+            np.moveaxis(np.stack([payload[i] for _, payload in entries]),
+                        0, leaves[i].ndim - 4)
+            for i in big
+        ]
+        new = self._revive_jit([leaves[i] for i in big], pages, updates)
+        for i, leaf in zip(big, new):
+            leaves[i] = leaf
+        self.cache = jax.tree.unflatten(treedef, leaves)
+        obs_emit("page_revive", pages=len(entries))
 
     # ------------------------------------------------------- page surface
 

@@ -25,6 +25,44 @@ suffix, and a request's chain grows page-by-page as it decodes
 ``cache_manager.py`` for the allocator/trie and the no-zeroing safety
 argument; both storage modes emit byte-identical greedy tokens.
 
+Chunked prefill (``FLEETX_SERVING_PREFILL_CHUNK``, default off;
+docs/SERVING.md): whole-prompt prefill-on-insert makes decode TPOT
+hostage to every long arriving prompt — prefill is MXU-bound, decode is
+HBM-bound, and one 4k-token prefill inside a tick stalls every active
+stream for its full duration. With a chunk size set, a prompt whose
+non-shared suffix exceeds it enters a ``prefilling`` lifecycle state:
+the engine runs AT MOST ONE chunk-sized prefill call per tick (a short
+prompt's whole-prompt call counts as that tick's chunk), interleaved
+with the batched decode, so no decode tick ever stalls more than ~one
+chunk of prefill compute. Chunks reuse the bucketed prefill jits at
+chunk granularity — long prompts stop minting per-length buckets up to
+``cache_len`` — writing through the same per-row ``cache_positions`` /
+page-scatter seams decode uses: paged chunks write straight into the
+lane's pages at absolute positions, slot chunks accumulate into a
+batch-1 working cache scattered into the slot on the final chunk. The
+final chunk samples the first token exactly where the one-call path
+would (same rng split discipline), so greedy tokens are BYTE-IDENTICAL
+to the unchunked engine, and chunk progress rides the transactional-tick
+snapshot: a mid-prefill fault rolls back, recovery requeues the request
+at the queue head (zero tokens emitted — byte-identity is structural)
+and the host-tier prefix cache below makes the re-prefill cheap.
+Deadlines are honored BETWEEN chunks: an expired request stops burning
+prefill compute and retires ``finish_reason="timeout"`` with its lane
+and pages freed (no partial-chunk leak — prefix registration only
+happens at completion).
+
+Host-DRAM KV spill tier (``FLEETX_SERVING_HOST_CACHE_BYTES``, default
+off; docs/SERVING.md two-level page cache): when the paged pool would
+LRU-evict a zero-ref warm trie page, the page (K/V + int8 scales) spills
+to a bounded host store instead of being destroyed, keyed by its token-
+chunk path; a later prompt with the same prefix revives it into fresh
+physical pages via one batched transfer per cache leaf and skips that
+prefill entirely — the millions-of-users shared-system-prompt scenario
+where the hot prefix set exceeds HBM. The store is content-addressed and
+engine-owned, so it SURVIVES replay recovery (the rebuilt pool matches
+the same keys) and revived bytes are exactly the spilled bytes: cold vs
+spill-revived decoding is byte-identical.
+
 Per-slot progress is carried as explicit ``cache_positions`` into the
 model (``SelfAttention._update_cache``), so slots decode at different
 depths in one batched forward; each row's attention window is
@@ -121,6 +159,7 @@ from fleetx_tpu.models.gpt.generation import (
     init_decode_cache,
 )
 from fleetx_tpu.serving.cache_manager import (
+    HostPageStore,
     PagedKVCacheManager,
     SlotKVCacheManager,
     scatter_slot,
@@ -259,7 +298,9 @@ class ServingEngine:
                  tick_timeout_s: Optional[float] = None,
                  grace_s: Optional[float] = None,
                  kv_dtype: Optional[str] = None,
-                 weight_dtype: Optional[str] = None):
+                 weight_dtype: Optional[str] = None,
+                 prefill_chunk: Optional[int] = None,
+                 host_cache_bytes: Optional[int] = None):
         gen_cfg = gen_cfg or GenerationConfig(decode_strategy="greedy")
         if gen_cfg.repetition_penalty != 1.0:
             raise ValueError("continuous batching does not support "
@@ -332,6 +373,22 @@ class ServingEngine:
         self.topk_cap = topk_cap or _env_int("FLEETX_SERVING_TOPK_CAP", 64)
         self.prefill_bucket = (prefill_bucket
                                or _env_int("FLEETX_SERVING_PREFILL_BUCKET", 32))
+        # chunked prefill (module docstring): 0/off = today's whole-prompt
+        # prefill-on-insert, byte-identical; >0 bounds per-tick prefill
+        # work to one chunk-sized call so decode TPOT never stalls longer
+        self.prefill_chunk = (prefill_chunk if prefill_chunk is not None
+                              else _env_int("FLEETX_SERVING_PREFILL_CHUNK", 0))
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0, got {self.prefill_chunk}")
+        # host-DRAM KV spill tier (module docstring): 0/off = LRU eviction
+        # destroys warm trie pages (today's behavior); >0 bounds the
+        # pinned-host store warm pages spill into instead
+        host_bytes = (host_cache_bytes if host_cache_bytes is not None
+                      else _env_int("FLEETX_SERVING_HOST_CACHE_BYTES", 0))
+        self._host_store = (HostPageStore(host_bytes)
+                            if host_bytes > 0 and self.paged
+                            and self.prefix_cache else None)
         self.log_every = (log_every if log_every is not None
                           else _env_int("FLEETX_SERVING_LOG_EVERY", 0))
         # admission control (module docstring): all default OFF — an
@@ -370,7 +427,8 @@ class ServingEngine:
         if self.paged:
             self.cache_manager = PagedKVCacheManager(
                 self.model, self.slots, cache_len, self.num_pages,
-                self.page_size, prefix_cache=self.prefix_cache)
+                self.page_size, prefix_cache=self.prefix_cache,
+                host_store=self._host_store)
         else:
             self.cache_manager = SlotKVCacheManager(self.model, self.slots,
                                                     cache_len)
@@ -383,6 +441,9 @@ class ServingEngine:
         self._next_id = 0
         self._ticks = 0
         self._active: Dict[int, Request] = {}  # slot -> request
+        # chunked prefill: slot -> the request mid-prefill there (at most
+        # one by policy — the FIFO head — a dict for snapshot symmetry)
+        self._prefilling: Dict[int, Request] = {}
         self._results: Dict[int, ServingResult] = {}
         self._state = self._init_state()
         # buffer donation halves cache HBM residency on TPU; skipped on
@@ -399,7 +460,11 @@ class ServingEngine:
         self._probe_jit = jax.jit(self._decode_fn, static_argnums=(4,))
         self._admit_jit = jax.jit(self._admit_fn, donate_argnums=())
         self._deactivate_jit = jax.jit(_deactivate)
-        self._prefill_jits = {}  # bucketed prompt length -> jitted prefill
+        # chunked slot prefill: fold the finished batch-1 working cache
+        # into the big slot cache (both operands are dead afterwards)
+        self._scatter_jit = jax.jit(
+            scatter_slot, donate_argnums=(0, 1) if donate else ())
+        self._prefill_jits = {}  # (kind, bucket_len) -> jitted prefill
         self._donate_cache = donate
         # observability (docs/OBSERVABILITY.md): one env var makes this
         # replica scrapeable, and /healthz turns 503 the instant
@@ -531,7 +596,8 @@ class ServingEngine:
         self._flush_shutdown_event()
         if (self._shutting_down and self._shutdown_deadline is not None
                 and t0 >= self._shutdown_deadline
-                and (len(self.scheduler) or self._active)):
+                and (len(self.scheduler) or self._active
+                     or self._prefilling)):
             # grace window over: everything still in flight returns NOW
             # with its partial tokens
             retired = self._retire_all("shutdown")
@@ -553,7 +619,8 @@ class ServingEngine:
             try:
                 with span("serving.tick", tick=self._ticks):
                     summary = self._step_inner(commit)
-                if summary["decoded"] or summary["admitted"]:
+                if (summary["decoded"] or summary["admitted"]
+                        or summary["chunked"]):
                     # a productive device tick proves the engine is healthy
                     # again — re-arm the recovery budget and strike counts
                     self._recoveries_consecutive = 0
@@ -569,31 +636,54 @@ class ServingEngine:
         if self.paged:
             self.metrics.observe_pages(self.cache_manager.pages_in_use,
                                        self.cache_manager.usable_pages)
+        if self._host_store is not None:
+            self.metrics.observe_host_tier(self._host_store)
         if self.log_every and self._ticks % self.log_every == 0:
             self.metrics.log_snapshot()
         summary.setdefault("recovered", False)
+        summary.setdefault("chunked", 0)
         summary["queue_depth"] = self.scheduler.queue_depth
         summary["active_slots"] = len(self._active)
+        summary["prefilling"] = len(self._prefilling)
         return summary
 
     def _step_inner(self, commit=lambda: None) -> Dict:
-        """The actual tick body: queued-expiry sweep, admissions, one
+        """The actual tick body: queued-expiry sweep, prefill work
+        (admissions — or, mid-chunked-prefill, exactly one chunk), one
         batched decode step, retirements, active-deadline sweep.
         ``commit`` re-bases the transactional snapshot after each
-        completed phase (see :meth:`step`)."""
+        completed phase (see :meth:`step`). With chunking enabled the
+        tick's prefill budget is ONE chunk-sized device call — a chunk
+        of the in-flight prompt or one short admission — so decode never
+        stalls longer (the ``prefill_stall_ms`` histogram measures it)."""
         timed_out = self._expire_queued(self._now())
         admitted = 0
-        while len(self.scheduler) and self._can_admit(self.scheduler.peek()):
-            self._admit(self.scheduler.pop_next())
-            admitted += 1
-            commit()  # an admission that completed stays admitted
+        chunked = 0
+        prefill_t0 = self._now()
+        if self._prefilling:
+            # FIFO holds: the mid-prefill request IS the admission head,
+            # so nothing else admits until its chunks finish (or expire)
+            n, expired = self._chunk_tick()
+            chunked += n
+            timed_out += expired
+            commit()  # chunk progress (prefill_pos) stays committed
+        else:
+            while (len(self.scheduler)
+                   and self._can_admit(self.scheduler.peek())):
+                self._admit(self.scheduler.pop_next())
+                admitted += 1
+                commit()  # an admission that completed stays admitted
+                if self.prefill_chunk:
+                    break  # one prefill-shaped device call per tick
+        if admitted or chunked:
+            self.metrics.observe_prefill_stall(self._now() - prefill_t0)
         decoded = len(self._active)
         retired = []
         if decoded:
             retired = self._tick_decode()
         # fresh clock: prefill/decode above may have eaten the deadline
         timed_out += self._expire_active(self._now())
-        return {"admitted": admitted, "decoded": decoded,
+        return {"admitted": admitted, "decoded": decoded, "chunked": chunked,
                 "retired": retired + timed_out, "timed_out": timed_out}
 
     def cancel(self, request_id: int) -> bool:
@@ -604,7 +694,8 @@ class ServingEngine:
         now = self._now()
         req = self.scheduler.remove(request_id)
         if req is None:
-            for r in self._active.values():
+            for r in (list(self._active.values())
+                      + list(self._prefilling.values())):
                 if r.id == request_id:
                     req = r
                     break
@@ -652,26 +743,36 @@ class ServingEngine:
         consumed donated buffers, so rollback restores host truth and
         :meth:`recover` rebuilds the device side from it. Metrics stay
         monotonic (a rolled-back tick's gauge samples are not unwound)."""
-        reqs = list(self.scheduler.snapshot()) + list(self._active.values())
+        reqs = (list(self.scheduler.snapshot()) + list(self._active.values())
+                + list(self._prefilling.values()))
         return {
             "queue": self.scheduler.snapshot(),
             "active": dict(self._active),
+            "prefilling": dict(self._prefilling),
             "results": dict(self._results),
             # per-request mutable fields the tick touches; tokens rolls
             # back by truncating to its pre-tick length (the list object
-            # itself is kept, appends are what a failed tick added)
+            # itself is kept, appends are what a failed tick added).
+            # prefill_pos/phase cover chunked-prefill progress, so a
+            # mid-chunk fault rolls the request back to its exact
+            # pre-tick chunk position (req.chunk_cache is device state —
+            # NOT captured; recovery requeues mid-prefill requests and
+            # rebuilds it from scratch)
             "reqs": [(r, r.slot, r.admit_time, r.first_token_time,
-                      len(r.tokens)) for r in reqs],
+                      len(r.tokens), r.prefill_pos, r.phase) for r in reqs],
         }
 
     def _restore(self, snap) -> None:
         self.scheduler.restore(snap["queue"])
         self._active = snap["active"]
+        self._prefilling = snap["prefilling"]
         self._results = snap["results"]
-        for r, slot, admit_t, first_t, ntok in snap["reqs"]:
+        for r, slot, admit_t, first_t, ntok, ppos, phase in snap["reqs"]:
             r.slot = slot
             r.admit_time = admit_t
             r.first_token_time = first_t
+            r.prefill_pos = ppos
+            r.phase = phase
             del r.tokens[ntok:]
 
     def _handle_tick_fault(self, snap, exc: Exception) -> Dict:
@@ -724,8 +825,11 @@ class ServingEngine:
         prompts one prefill) and reconstructing its decode-lane scalars —
         including the per-request RNG stream position, so sampling
         requests also resume byte-identically. Public: call it after an
-        external device reset too. The warm prefix cache (retired
-        requests' parked pages) is dropped — a correctness-neutral loss.
+        external device reset too. The DEVICE warm prefix cache (retired
+        requests' parked pages) is dropped — a correctness-neutral loss —
+        but the host spill tier survives: its entries are keyed by token
+        content, so the rebuilt pool revives them on the next match.
+        Mid-prefill (chunked) requests requeue at the head and restart.
         Returns the ids of requests retired because their own replay
         failed (their fault followed them into recovery — poison)."""
         self._recoveries_consecutive += 1
@@ -744,13 +848,30 @@ class ServingEngine:
                   recovery=self.metrics.engine_recoveries):
             old_active = sorted(self._active.items())
             self._active = {}
+            # mid-prefill (chunked) requests: their partial KV died with
+            # the device cache and ZERO tokens were emitted, so they go
+            # back to the queue HEAD (they were the head when admitted)
+            # and restart chunked prefill — byte-identity is structural,
+            # and the host tier below keeps their shared prefix cheap
+            for _, req in sorted(self._prefilling.items(), reverse=True):
+                req.slot = None
+                req.prefill_pos = 0
+                req.chunk_cache = None
+                req.phase = "queued"
+                self.scheduler.requeue(req)
+            self._prefilling = {}
             self._tables_dev = None
             self._tables_version = -1
             self._state = self._init_state()
             if self.paged:
+                # the HOST spill tier survives the rebuild: its entries
+                # are keyed by token-chunk path, not trie-node identity,
+                # so replayed/requeued prompts revive them from the new
+                # pool (only the DEVICE warm cache is a recovery loss)
                 self.cache_manager = PagedKVCacheManager(
                     self.model, self.slots, self.cache_len, self.num_pages,
-                    self.page_size, prefix_cache=self.prefix_cache)
+                    self.page_size, prefix_cache=self.prefix_cache,
+                    host_store=self._host_store)
             else:
                 self.cache_manager = SlotKVCacheManager(
                     self.model, self.slots, self.cache_len)
@@ -960,7 +1081,7 @@ class ServingEngine:
         # an idle engine drains without a single tick, so flush the
         # deferred shutdown event here too (step() flushes it otherwise)
         self._flush_shutdown_event()
-        while len(self.scheduler) or self._active:
+        while len(self.scheduler) or self._active or self._prefilling:
             self.step()  # the deadline check inside step() retires leftovers
         out, self._results = self._results, {}
         return out
@@ -984,7 +1105,8 @@ class ServingEngine:
         for req in self.scheduler.drain_all():
             self._finalize(req, reason, now)
             retired.append(req.id)
-        for req in list(self._active.values()):
+        for req in (list(self._active.values())
+                    + list(self._prefilling.values())):
             self._evict(req, reason, now)
             retired.append(req.id)
         return retired
@@ -1022,7 +1144,7 @@ class ServingEngine:
         """Tick until queue and slots are empty (or ``max_ticks``), then
         return-and-clear every finished result since the last drain."""
         n = 0
-        while len(self.scheduler) or self._active:
+        while len(self.scheduler) or self._active or self._prefilling:
             self.step()
             n += 1
             if max_ticks is not None and n >= max_ticks:
@@ -1263,20 +1385,26 @@ class ServingEngine:
                 jnp.asarray(req.top_p, jnp.float32),
                 step_key)
 
-    def _guarded_prefill(self, req: Request, fn, args, bucket=None):
+    def _guarded_prefill(self, req: Request, fn, args, bucket=None,
+                         chunk_cache: bool = False):
         """One prefill device call through the fault-injection hook;
-        stores the returned cache. Deliberately NOT under the hung-tick
-        watchdog: prefill calls legitimately include fresh-bucket XLA
-        compiles (seconds), and replay recovery re-prefills through here —
-        a watchdog here would misread every cold compile as a hang and
-        quarantine healthy requests. The watchdog budget is calibrated for
-        the steady-state decode tick, the loop that actually wedges."""
+        stores the returned cache (into ``req.chunk_cache`` for chunked
+        slot calls, the cache manager otherwise). Deliberately NOT under
+        the hung-tick watchdog: prefill calls legitimately include
+        fresh-bucket XLA compiles (seconds), and replay recovery
+        re-prefills through here — a watchdog here would misread every
+        cold compile as a hang and quarantine healthy requests. The
+        watchdog budget is calibrated for the steady-state decode tick,
+        the loop that actually wedges."""
         attempt = self._fault_prefills
         self._fault_prefills += 1
         with span("serving.prefill", request=req.id, bucket=bucket):
             faults.on_serving_prefill(attempt, req.id)
             cache, tok = fn(*args)
-        self.cache_manager.cache = cache
+        if chunk_cache:
+            req.chunk_cache = cache
+        else:
+            self.cache_manager.cache = cache
         return tok
 
     def _slot_prefill_call(self, req: Request, tokens, slot,
@@ -1286,9 +1414,10 @@ class ServingEngine:
         (``tokens`` = the request's history) returns None."""
         bucket = -(-len(tokens) // self.prefill_bucket) * self.prefill_bucket
         bucket = min(max(bucket, len(tokens)), self.cache_len)
-        fn = self._prefill_jits.get(bucket)
+        fn = self._prefill_jits.get(("slot", bucket))
         if fn is None:
-            fn = self._prefill_jits[bucket] = self._make_prefill(bucket)
+            fn = self._prefill_jits[("slot", bucket)] = \
+                self._make_prefill(bucket)
         padded = np.zeros(bucket, np.int32)
         padded[:len(tokens)] = tokens
         step_key = carry_key = None
@@ -1305,12 +1434,18 @@ class ServingEngine:
                             replay: bool = False):
         """Batch-1 prefill of the non-shared ``suffix`` straight into
         ``lane``'s pages at absolute positions ``shared..``. Admission
-        returns ``(first_token, carry_key)``; replay returns None."""
+        returns ``(first_token, carry_key)``; replay returns None.
+        Chunked prefill reuses this call verbatim — an intermediate
+        chunk is exactly a ``replay`` call (KV writes only, inert
+        sampler, no rng consumed) at its chunk's write offset, and the
+        final chunk is exactly an admission call whose ``true_len``
+        lands on the last prompt token."""
         bucket = -(-len(suffix) // self.prefill_bucket) * self.prefill_bucket
         bucket = min(max(bucket, len(suffix)), self.cache_len - shared)
-        fn = self._prefill_jits.get(bucket)
+        fn = self._prefill_jits.get(("paged", bucket))
         if fn is None:
-            fn = self._prefill_jits[bucket] = self._make_paged_prefill(bucket)
+            fn = self._prefill_jits[("paged", bucket)] = \
+                self._make_paged_prefill(bucket)
         padded = np.zeros(bucket, np.int32)
         padded[:len(suffix)] = suffix
         step_key = carry_key = None
@@ -1324,36 +1459,93 @@ class ServingEngine:
         tok = self._guarded_prefill(req, fn, args, bucket=bucket)
         return None if replay else (tok, carry_key)
 
-    def _slot_prefill(self, req: Request):
-        """Slot-path admission storage: claim a slot, prefill the WHOLE
-        prompt batch-1 into a fresh cache and scatter it into the slot's
-        row. Returns ``(first_token, carry_key)``; sets ``req.slot``."""
-        slot = self.cache_manager.alloc(req.id, req.prompt_len)
-        req.slot = slot
-        return self._slot_prefill_call(req, req.prompt, slot)
+    def _make_chunk_prefill(self, bucket_len: int):
+        """Jitted slot-path CHUNK prefill: write ``bucket_len`` prompt
+        tokens into the request's batch-1 working cache at absolute
+        positions ``wpos..`` through the per-row ``cache_positions`` seam
+        (the paged path needs no sibling — ``_make_paged_prefill`` already
+        takes a write offset), and sample from the chunk's last true
+        token — the returned token only matters on the FINAL chunk, where
+        ``true_len - 1`` is the last prompt position, exactly where the
+        one-call path samples."""
+        max_pos = self.model.cfg.max_position_embeddings
 
-    def _paged_prefill(self, req: Request):
-        """Paged-path admission storage: claim a lane + page chain (trie-
-        shared prefix pages skip their prefill entirely), run the batch-1
-        suffix prefill straight into the pages, publish the prompt's full
-        pages for sharing. Returns ``(first_token, carry_key)``; sets
-        ``req.slot``."""
-        alloc = self.cache_manager.alloc(req.id, req.prompt)
-        if alloc is None:  # _can_admit() passed, so this is an invariant
-            raise RuntimeError(  # breach — fail loudly, not via unpack
-                f"paged alloc failed after admission check for request "
-                f"{req.id} (prompt {req.prompt_len} tokens; "
-                f"{self.cache_manager.pool.free_pages} pages free)")
-        lane, shared = alloc
-        req.slot = lane
-        tok, carry_key = self._paged_prefill_call(
-            req, req.prompt[shared:], shared, lane)
-        self.cache_manager.register_prefix(lane, req.prompt)
-        pool = self.cache_manager.pool
-        self.metrics.record_prefix(
-            shared, req.prompt_len,
-            int(pool.alloc_counts[lane] - pool.shared_counts[lane]))
-        return tok, carry_key
+        def prefill(params, cache, chunk, true_len, wpos, eos, min_new,
+                    greedy, temperature, top_k, top_p, key):
+            params = self._dequant_params(params)
+            ids = chunk[None, :]
+            # absolute positions wpos..; the right-pad bucket tail is
+            # causally invisible to every real query and its writes are
+            # overwritten by the next chunk (or decode) before the live
+            # window ever reaches them — same contract as the one-call
+            # bucket tail
+            pos = jnp.minimum(wpos + jnp.arange(bucket_len, dtype=jnp.int32),
+                              max_pos - 1)[None, :]
+            logits, cache = decode_step(
+                self.model, params, cache, ids, pos,
+                cache_positions=wpos[None])
+            last = jax.lax.dynamic_slice_in_dim(
+                logits[0], true_len - 1, 1, axis=0).astype(jnp.float32)
+            vocab = last.shape[-1]
+            last = jnp.where(
+                (jnp.arange(vocab)[None, :] == eos) & (min_new > 0),
+                _NEG, last)
+            tok = sample_tokens(
+                last, key[None], greedy[None], temperature[None],
+                top_k[None], top_p[None], topk_cap=self.topk_cap)[0]
+            return cache, tok
+
+        return jax.jit(
+            prefill, donate_argnums=(1,) if self._donate_cache else ())
+
+    def _chunk_prefill_call(self, req: Request, tokens, wpos,
+                            replay: bool = False):
+        """One slot-path chunk: ``tokens`` into ``req.chunk_cache`` at
+        absolute positions ``wpos..``. Intermediate chunks pass
+        ``replay=True`` (KV only, rng untouched, returns None); the
+        final chunk returns ``(first_token, carry_key)``."""
+        bucket = -(-len(tokens) // self.prefill_bucket) * self.prefill_bucket
+        # cap at the REMAINING cache span (mirroring the paged call's
+        # cache_len - shared): a bucket crossing cache_len would clamp
+        # its dynamic_update_slice start and overwrite live prompt KV
+        bucket = min(max(bucket, len(tokens)), self.cache_len - wpos)
+        fn = self._prefill_jits.get(("chunk", bucket))
+        if fn is None:
+            fn = self._prefill_jits[("chunk", bucket)] = \
+                self._make_chunk_prefill(bucket)
+        padded = np.zeros(bucket, np.int32)
+        padded[:len(tokens)] = tokens
+        step_key = carry_key = None
+        if not replay:
+            step_key, carry_key = jax.random.split(req.rng_key)
+        args = (self.params, req.chunk_cache, jnp.asarray(padded),
+                jnp.asarray(len(tokens), jnp.int32),
+                jnp.asarray(wpos, jnp.int32),
+                *self._prefill_scalars(req, replay, step_key))
+        tok = self._guarded_prefill(req, fn, args, bucket=bucket,
+                                    chunk_cache=True)
+        return None if replay else (tok, carry_key)
+
+    def _claim_storage(self, req: Request) -> int:
+        """Claim a decode lane (+ page chain on the paged path) for one
+        admission; sets ``req.slot`` and returns the shared-prefix token
+        count (trie + host-revived; 0 on the slot path)."""
+        if self.paged:
+            alloc = self.cache_manager.alloc(req.id, req.prompt)
+            if alloc is None:  # _can_admit() passed, so this is an
+                raise RuntimeError(  # invariant breach — fail loudly
+                    f"paged alloc failed after admission check for request "
+                    f"{req.id} (prompt {req.prompt_len} tokens; "
+                    f"{self.cache_manager.pool.free_pages} pages free)")
+            lane, shared = alloc
+            req.slot = lane
+            pool = self.cache_manager.pool
+            self.metrics.record_prefix(
+                shared, req.prompt_len,
+                int(pool.alloc_counts[lane] - pool.shared_counts[lane]))
+            return shared
+        req.slot = self.cache_manager.alloc(req.id, req.prompt_len)
+        return 0
 
     def _install_lane(self, req: Request, *, tok: int, length: int,
                       decoded: int, active: bool, carry_key) -> None:
@@ -1376,18 +1568,105 @@ class ServingEngine:
         )
 
     def _admit(self, req: Request) -> None:
+        """Admit the FIFO head: claim storage, then either the one-call
+        whole-suffix prefill (chunking off, or the non-shared suffix fits
+        one chunk — today's path, byte-identical) or enter the
+        ``prefilling`` state and run the first chunk."""
         self._fault_ctx = ("prefill", req.id)
         with span("serving.admit", request=req.id,
                   prompt_len=req.prompt_len):
-            tok, carry_key = (self._paged_prefill(req) if self.paged
-                              else self._slot_prefill(req))
+            shared = self._claim_storage(req)
+            if (self.prefill_chunk
+                    and req.prompt_len - shared > self.prefill_chunk):
+                req.prefill_pos = shared
+                req.phase = "prefilling"
+                if not self.paged:
+                    req.chunk_cache = init_decode_cache(self.model, 1)
+                self._prefilling[req.slot] = req
+                req.admit_time = self._now()
+                self.metrics.record_admit(req.admit_time - req.submit_time)
+                self._fault_ctx = None
+                self._run_chunk(req)  # this tick's one chunk of budget
+                return
+            if self.paged:
+                tok, carry_key = self._paged_prefill_call(
+                    req, req.prompt[shared:], shared, req.slot)
+                self.cache_manager.register_prefix(req.slot, req.prompt)
+            else:
+                tok, carry_key = self._slot_prefill_call(
+                    req, req.prompt, req.slot)
         self._fault_ctx = None
         self._prefill_strikes.pop(req.id, None)  # survived its prefill
-        tok = int(tok)  # host sync: the first token is now observable
         now = self._now()
-        req.admit_time = req.first_token_time = now
-        req.tokens.append(tok)
+        req.admit_time = now
         self.metrics.record_admit(now - req.submit_time)
+        self._finish_first_token(req, int(tok), carry_key)
+
+    def _run_chunk(self, req: Request) -> None:
+        """One prefill chunk for a mid-prefill request. Intermediate
+        chunks only write KV (inert sampler, rng untouched); the final
+        chunk samples the first token exactly like the one-call path and
+        promotes the request to the decode set."""
+        start = req.prefill_pos
+        end = min(start + self.prefill_chunk, req.prompt_len)
+        final = end == req.prompt_len
+        tokens = req.prompt[start:end]
+        self._fault_ctx = ("prefill", req.id)
+        with span("serving.prefill_chunk", request=req.id, start=start,
+                  final=final):
+            if self.paged:
+                out = self._paged_prefill_call(req, tokens, start, req.slot,
+                                               replay=not final)
+            else:
+                out = self._chunk_prefill_call(req, tokens, start,
+                                               replay=not final)
+        self._fault_ctx = None
+        req.prefill_pos = end
+        self.metrics.record_prefill_chunk(len(tokens))
+        if not final:
+            return
+        tok, carry_key = out
+        if self.paged:
+            self.cache_manager.register_prefix(req.slot, req.prompt)
+        else:
+            # fold the finished batch-1 working cache into the slot row
+            self.cache_manager.cache = self._scatter_jit(
+                self.cache_manager.cache, req.chunk_cache,
+                jnp.asarray(req.slot, jnp.int32))
+            req.chunk_cache = None
+        del self._prefilling[req.slot]
+        self._prefill_strikes.pop(req.id, None)
+        self._finish_first_token(req, int(tok), carry_key)
+
+    def _chunk_tick(self):
+        """Advance the mid-prefill request by ONE chunk this tick —
+        after checking its deadlines, so an expired request stops
+        burning prefill compute (retired ``finish_reason="timeout"``
+        with lane + pages freed; prefix registration only happens at
+        completion, so nothing leaks). A request that has not produced
+        its first token is still "waiting" in the queue-TTL sense, so
+        BOTH limits apply between chunks. Returns ``(chunks_executed,
+        timed_out_ids)``."""
+        slot = min(self._prefilling)
+        req = self._prefilling[slot]
+        now = self._now()
+        waited = now - req.submit_time
+        if ((req.queue_ttl_s and waited > req.queue_ttl_s)
+                or (req.deadline_s and waited > req.deadline_s)):
+            self._evict(req, "timeout", now)
+            obs_emit("request_timeout", request=req.id, where="prefilling")
+            return 0, [req.id]
+        self._run_chunk(req)
+        return 1, []
+
+    def _finish_first_token(self, req: Request, tok: int,
+                            carry_key) -> None:
+        """Shared admission tail: the first token is on the host —
+        install the decode lane, record TTFT, fire the callback, route
+        to the active set or straight to retirement."""
+        now = self._now()
+        req.first_token_time = now
+        req.tokens.append(tok)
         self.metrics.record_first_token(now - req.submit_time)
         self.metrics.record_tokens(1)
         done_eos = req.eos_token_id >= 0 and tok == req.eos_token_id
@@ -1403,6 +1682,7 @@ class ServingEngine:
         elif done:
             self._finalize(req, "eos" if done_eos else "max_length", now)
         else:
+            req.phase = "active"
             self._active[req.slot] = req
 
     def _decode_fn(self, params, cache, st, tables, all_greedy: bool):
@@ -1548,8 +1828,12 @@ class ServingEngine:
         obs_emit("callback_error", request=req.id)
 
     def _finalize(self, req: Request, reason: str, now: float) -> None:
-        if req.slot in self._active:
+        if req.slot in self._active and self._active[req.slot] is req:
             del self._active[req.slot]
+        if req.slot in self._prefilling and self._prefilling[req.slot] is req:
+            del self._prefilling[req.slot]
+        req.chunk_cache = None  # a mid-prefill retiree drops its working
+        req.phase = "finished"  # cache; pages/lane free below (no leak)
         if req.slot is not None:  # queued-expiry/cancel never held a slot
             self.cache_manager.free(req.slot)
         self.metrics.record_retire(now - req.submit_time, reason)

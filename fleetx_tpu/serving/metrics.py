@@ -125,6 +125,22 @@ class ServingMetrics:
         self._c_prompt_tokens = counter(
             "fleetx_serving_prompt_tokens_total",
             "Prompt tokens across admitted paged requests")
+        # chunked-prefill + host-spill-tier story (docs/SERVING.md):
+        # how long ticks stall on prefill work, how many chunks ran, and
+        # what the two-level page cache moved between HBM and host DRAM
+        self._c_prefill_chunks = counter(
+            "fleetx_serving_prefill_chunks_total",
+            "Chunked-prefill device calls executed (one per tick max)")
+        self._c_host_spilled = counter(
+            "fleetx_serving_host_spilled_pages_total",
+            "Warm KV pages spilled to the host-DRAM tier on eviction")
+        self._c_host_revived = counter(
+            "fleetx_serving_host_revived_pages_total",
+            "Spilled pages revived into device pages on a prefix match")
+        self._c_host_evicted = counter(
+            "fleetx_serving_host_evicted_pages_total",
+            "Host-tier entries dropped under the byte budget (LRU)")
+        self._host_synced = (0, 0, 0)  # last (spilled, revived, evicted)
         self._g_queue_depth = gauge(
             "fleetx_serving_queue_depth",
             "Requests currently waiting for a decode lane")
@@ -141,6 +157,12 @@ class ServingMetrics:
         self._g_pages_total = gauge(
             "fleetx_serving_pages_total",
             "Usable KV pages in the shared pool (paged mode)")
+        self._g_host_bytes = gauge(
+            "fleetx_serving_host_cache_bytes",
+            "Bytes of spilled KV pages resident in the host-DRAM tier")
+        self._g_host_pages = gauge(
+            "fleetx_serving_host_cache_pages",
+            "Spilled KV pages resident in the host-DRAM tier")
         # quantized-serving config (docs/QUANTIZATION.md): the info-style
         # family carries the active precision pair as labels; the bytes
         # gauges make the HBM win scrapeable next to tokens/s
@@ -188,6 +210,13 @@ class ServingMetrics:
         self._h_pages_per_req = hist(
             "fleetx_serving_pages_per_request",
             "Fresh (non-shared) pages claimed per admitted paged request")
+        # how long a tick's decode was stalled by prefill work — under
+        # chunking this is bounded by ~one chunk-sized call (the claim
+        # tools/bench_serving.py's chunked record prices)
+        self._h_prefill_stall = hist(
+            "fleetx_serving_prefill_stall_ms",
+            "Milliseconds a tick spent on prefill work (admissions + "
+            "chunks) before its batched decode ran")
         self._reasons: Dict[str, object] = {}  # reason -> counter child
         self._first_token_t: Optional[float] = None
         self._last_token_t: Optional[float] = None
@@ -262,6 +291,34 @@ class ServingMetrics:
         self._g_kv_bytes.set(int(kv_bytes_per_token))
         self._g_weight_bytes.set(int(weight_bytes))
         self._g_kv_cache_bytes.set(int(kv_cache_bytes))
+
+    def observe_prefill_stall(self, stall_s: float) -> None:
+        """One tick spent ``stall_s`` seconds on prefill work (whole
+        admissions or one chunk) before its decode call."""
+        self._h_prefill_stall.observe(stall_s * 1e3)
+
+    def record_prefill_chunk(self, tokens: int) -> None:
+        """One chunked-prefill device call wrote ``tokens`` prompt
+        tokens (the count rides the counter; per-chunk size is static)."""
+        del tokens  # chunk size is a config constant; count is the signal
+        self._c_prefill_chunks.inc()
+
+    def observe_host_tier(self, store) -> None:
+        """Per-tick sync from a :class:`HostPageStore`: gauges track its
+        current bytes/entries, counters advance by the store's lifetime
+        deltas since the last sync (registry counters only increment)."""
+        self._g_host_bytes.set(store.nbytes)
+        self._g_host_pages.set(len(store))
+        now = (store.spilled_pages, store.revived_pages,
+               store.evicted_pages)
+        last = self._host_synced
+        for child, delta in zip(
+                (self._c_host_spilled, self._c_host_revived,
+                 self._c_host_evicted),
+                (now[0] - last[0], now[1] - last[1], now[2] - last[2])):
+            if delta > 0:
+                child.inc(delta)
+        self._host_synced = now
 
     def observe_pages(self, pages_in_use: int, pages_total: int) -> None:
         """Per-tick page-pool gauge sample (paged mode only)."""
@@ -385,6 +442,26 @@ class ServingMetrics:
         return int(self._c_prompt_tokens.value)
 
     @property
+    def prefill_chunks(self) -> int:
+        """Chunked-prefill device calls executed."""
+        return int(self._c_prefill_chunks.value)
+
+    @property
+    def host_spilled_pages(self) -> int:
+        """Warm pages spilled to the host tier."""
+        return int(self._c_host_spilled.value)
+
+    @property
+    def host_revived_pages(self) -> int:
+        """Spilled pages revived on a prefix match."""
+        return int(self._c_host_revived.value)
+
+    @property
+    def host_evicted_pages(self) -> int:
+        """Host-tier entries dropped under the byte budget."""
+        return int(self._c_host_evicted.value)
+
+    @property
     def queue_depth(self) -> int:
         """Last sampled queue depth."""
         return int(self._g_queue_depth.value)
@@ -441,6 +518,7 @@ class ServingMetrics:
         ticks = self.ticks
         ttft_p50, ttft_p95 = self._h_ttft.quantiles((50, 95))
         tick_p50, tick_p99 = self._h_tick.quantiles((50, 99))
+        stall_p50, stall_p99 = self._h_prefill_stall.quantiles((50, 99))
         return {
             "submitted": self.submitted,
             "admitted": self.admitted,
@@ -480,6 +558,18 @@ class ServingMetrics:
             "pages_per_request_mean": self._h_pages_per_req.mean,
             "pages_in_use": self.pages_in_use,
             "pages_total": self.pages_total,
+            # chunked-prefill + host-tier story (docs/SERVING.md): decode
+            # stall bounded by one chunk, prefix hits sustained past the
+            # device pool via the host-DRAM spill tier
+            "prefill_chunks": self.prefill_chunks,
+            "prefill_stall_ms_p50": stall_p50,
+            "prefill_stall_ms_p99": stall_p99,
+            "prefill_stall_ms_max": self._h_prefill_stall.max,
+            "host_spilled_pages": self.host_spilled_pages,
+            "host_revived_pages": self.host_revived_pages,
+            "host_evicted_pages": self.host_evicted_pages,
+            "host_cache_bytes": int(self._g_host_bytes.value),
+            "host_cache_pages": int(self._g_host_pages.value),
             "page_occupancy_mean": (self._h_page_occ.mean or 0.0),
             "page_occupancy_peak": (self._h_page_occ.max or 0.0),
             # precision story (docs/QUANTIZATION.md): what the decode path

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-from typing import Callable, List, Optional
+from typing import Any, Callable, List, Optional
 
 import jax
 import numpy as np
@@ -27,7 +27,17 @@ class Request:
     engine base key and the request id, or an explicit per-request seed),
     so repeated identical submissions sample independently. ``on_token``
     streams each decoded token as ``on_token(request_id, token, finished)``
-    the tick it is produced."""
+    the tick it is produced.
+
+    Lifecycle (``phase``): ``queued`` → [``prefilling``] → ``active`` →
+    ``finished``. The ``prefilling`` state exists only under chunked
+    prefill (``FLEETX_SERVING_PREFILL_CHUNK`` > 0, docs/SERVING.md): a
+    long prompt's KV ingestion is spread over scheduler ticks — one
+    chunk per tick, interleaved with the batched decode — with
+    ``prefill_pos`` tracking how many prompt tokens (shared prefix
+    included) have been written so far and, on the slot path,
+    ``chunk_cache`` holding the batch-1 working cache the chunks
+    accumulate into before the final scatter."""
 
     id: int
     prompt: np.ndarray  # [prompt_len] int32, no padding
@@ -51,6 +61,12 @@ class Request:
     admit_time: Optional[float] = None
     first_token_time: Optional[float] = None
     tokens: List[int] = dataclasses.field(default_factory=list)
+    # chunked-prefill lifecycle (class docstring): covered by the
+    # engine's transactional-tick snapshot so a rolled-back tick
+    # restores chunk progress exactly
+    phase: str = "queued"
+    prefill_pos: int = 0
+    chunk_cache: Any = dataclasses.field(default=None, repr=False)
 
     @property
     def prompt_len(self) -> int:
@@ -71,6 +87,14 @@ class FIFOScheduler:
     def pop_next(self) -> Optional[Request]:
         """Next request to admit (None when the queue is empty)."""
         return self._queue.popleft() if self._queue else None
+
+    def requeue(self, request: Request) -> None:
+        """Put a request back at the HEAD of the queue — the recovery
+        path for a mid-prefill (chunked) request whose partial KV died
+        with the device cache: it was the FIFO head when admitted and no
+        token has been emitted, so restarting it from the front preserves
+        both arrival order and byte-identity."""
+        self._queue.appendleft(request)
 
     def peek(self) -> Optional[Request]:
         """Next request WITHOUT removing it — the page-granular admission

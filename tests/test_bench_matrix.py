@@ -36,6 +36,41 @@ def test_two_case_grid(monkeypatch, tmp_path):
         assert np.isfinite(rec["loss_last"])
 
 
+@pytest.mark.slow  # two tiny bench_serving subprocesses (~80s)
+def test_serving_tuning_mode(tmp_path, monkeypatch):
+    """--serving-tuning drives the PR 10 residual tuning debts (page-size
+    sweep + int8 block_k retune) through bench_serving subprocesses and
+    banks a winners summary — the grid a TPU window auto-banks tuned
+    configs from (ROADMAP item 3c)."""
+    monkeypatch.setenv("BENCH_SERVING_TINY", "1")
+    out = tmp_path / "tuning.json"
+    bench_matrix.main(["--serving-tuning", "--page-sizes", "8",
+                       "--block-k", "256", "--out", str(out),
+                       "--timeout", "420"])
+    grid = json.loads(out.read_text())
+    assert grid["summary"]["passed"] == grid["summary"]["cases"] == 2
+    assert grid["summary"]["best_page_size"] == 8
+    assert grid["summary"]["best_int8_block_k"] == 256
+    cases = {r["case"]: r for r in grid["results"]}
+    assert cases["PageSweep[8]"]["tokens_per_s"] > 0
+    assert cases["Int8BlockK256"]["tokens_per_s"] > 0
+
+
+def test_serving_tuning_summary_flags_failures():
+    """A failed tuning case must surface in failed_cases, not vanish."""
+    results = [
+        {"case": "PageSweep[16]", "ok": True, "best_page_size": 16,
+         "tokens_per_s": 10.0, "sweep": []},
+        {"case": "Int8BlockK128", "ok": False, "block_k": 128,
+         "log_tail": "boom"},
+        {"case": "Int8BlockK256", "ok": True, "block_k": 256,
+         "tokens_per_s": 12.0},
+    ]
+    s = bench_matrix._serving_tuning_summary(results)
+    assert s["failed_cases"] == ["Int8BlockK128"]
+    assert s["best_int8_block_k"] == 256 and s["best_page_size"] == 16
+
+
 def test_case_grids_factor_their_device_counts():
     """Every N1C16/N1C32 case's degree product must equal the device count
     (the same check init_dist_env enforces at launch), so entry scripts

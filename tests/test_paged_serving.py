@@ -34,7 +34,12 @@ from serving_parity import assert_token_parity, one_shot_tokens
 
 from fleetx_tpu.models.gpt.generation import GenerationConfig
 from fleetx_tpu.models.gpt.model import GPTConfig, GPTForPretraining
-from fleetx_tpu.serving import PagedKVCacheManager, PagePool, ServingEngine
+from fleetx_tpu.serving import (
+    HostPageStore,
+    PagedKVCacheManager,
+    PagePool,
+    ServingEngine,
+)
 
 CFG = GPTConfig(
     vocab_size=97,
@@ -143,6 +148,156 @@ def test_pagepool_random_ops_property():
         pool.free(lane)
     _check_pool_invariants(pool, {})
     assert pool.pages_in_use == 0  # everything returned (cached or free)
+
+
+class _RecordingStore(HostPageStore):
+    """HostPageStore that journals puts so the churn below can assert a
+    revived payload is EXACTLY what was spilled under that token path."""
+
+    def __init__(self, capacity_bytes):
+        super().__init__(capacity_bytes)
+        self.journal = {}  # key -> last payload put
+
+    def put(self, key, payload, nbytes):
+        ok = super().put(key, payload, nbytes)
+        if ok:
+            self.journal[key] = payload
+        return ok
+
+
+def _host_pool(num_pages=16, page_size=4, lanes=5, lane_pages=8,
+               capacity_bytes=10 * 64):
+    """PagePool wired to a recording host store with dummy device
+    callbacks: spill hands each page a unique payload token, revive
+    journals what came back — no model, no backend, pure host."""
+    state = {"serial": 0, "revived": []}
+    store = _RecordingStore(capacity_bytes)
+
+    def spill_fn(pages):
+        out = []
+        for p in pages:
+            state["serial"] += 1
+            out.append((("payload", p, state["serial"]), 64))
+        return out
+
+    def revive_fn(entries):
+        state["revived"].extend(entries)
+
+    pool = PagePool(num_pages, page_size, lanes, lane_pages,
+                    host_store=store, spill_fn=spill_fn,
+                    revive_fn=revive_fn)
+    return pool, store, state
+
+
+def test_pagepool_spill_revive_churn_property():
+    """The spill/revive extension of the random-ops churn: a small pool
+    + a byte-bounded host tier under alloc/register/grow/free pressure
+    with heavy prompt reuse. After EVERY op ``check_invariants()`` must
+    hold (conservation, refcounts, trie, host-store byte accounting),
+    and every payload ``revive_fn`` receives must be the exact payload
+    spilled under that page's token path — the pool can never hand a
+    prompt someone else's KV."""
+    rng = np.random.RandomState(42)
+    pool, store, state = _host_pool()
+    held = {}
+    zoo = [rng.randint(1, 7, (n,)).astype(np.int32)
+           for n in (5, 9, 13, 17, 21, 29)]
+    for step in range(500):
+        op = rng.randint(3)
+        if op == 0 and len(held) < pool.lanes:
+            lane = min(set(range(pool.lanes)) - set(held))
+            toks = zoo[rng.randint(len(zoo))]
+            if rng.randint(2):
+                toks = np.concatenate(
+                    [toks, rng.randint(1, 7, (rng.randint(1, 4),))]
+                ).astype(np.int32)
+            state["revived"].clear()
+            shared = pool.alloc(lane, toks)
+            if shared is not None:
+                assert shared % pool.page_size == 0
+                assert shared <= len(toks) - 1
+                # every revived payload is the one spilled for that path
+                for page, payload in state["revived"]:
+                    node = pool._node_of_page[page]
+                    key = pool._node_key(node)
+                    assert store.journal.get(key) == payload, (
+                        f"page {page} revived someone else's payload")
+                pool.register_prefix(lane, toks)
+                held[lane] = toks
+        elif op == 1 and held:
+            lane = sorted(held)[rng.randint(len(held))]
+            pos = int(pool.alloc_counts[lane]) * pool.page_size
+            if pos < pool.lane_pages * pool.page_size:
+                pool.ensure_page(lane, pos)
+        elif op == 2 and held:
+            lane = sorted(held)[rng.randint(len(held))]
+            pool.free(lane)
+            del held[lane]
+        pool.check_invariants()
+        _check_pool_invariants(pool, held)
+    assert store.spilled_pages > 0, "churn never exercised the spill path"
+    assert store.revived_pages > 0, "churn never exercised the revive path"
+    assert store.evicted_pages > 0, (
+        "churn never pressured the host byte budget (capacity too big?)")
+    for lane in sorted(held):
+        pool.free(lane)
+    pool.check_invariants()
+
+
+def test_pagepool_spill_then_host_revive_exact():
+    """Deterministic two-tier lifecycle: a registered prefix parks warm,
+    pool pressure SPILLS it to the host store (free_pages unchanged — a
+    spilled page is a freed page), and a matching re-alloc revives it as
+    shared tokens (prefill skipped) with the journaled payload, drawing
+    physical pages like a fresh claim."""
+    pool, store, state = _host_pool(num_pages=5, page_size=4, lanes=3,
+                                    lane_pages=4)
+    a = np.arange(1, 10, dtype=np.int32)  # 2 full chunks + tail = 3 pages
+    assert pool.alloc(0, a) == 0
+    pool.register_prefix(0, a)
+    pool.free(0)
+    assert pool.cached_pages == 2 and len(store) == 0  # warm, not spilled
+    b = np.arange(20, 33, dtype=np.int32)  # 13 tokens: 4 fresh pages
+    assert pool.alloc(1, b) == 0  # drains the stack -> A's subtree spills
+    assert len(store) == 2 and store.spilled_pages == 2
+    assert pool.cached_pages == 0
+    pool.check_invariants()
+    pool.free(1)
+    # no trie node survives for A, but the HOST match revives both chunks
+    state["revived"].clear()
+    assert pool.alloc(2, a) == 8
+    assert len(state["revived"]) == 2
+    assert store.revived_pages == 2
+    # inclusive tier: the entries STAY after revival (a later fault that
+    # destroys the device copy can revive them again)
+    assert len(store) == 2
+    for page, payload in state["revived"]:
+        key = pool._node_key(pool._node_of_page[page])
+        assert store.journal[key] == payload
+    pool.check_invariants()
+    # revived pages are real trie pages again: a third tenant shares them
+    pool.register_prefix(2, a)
+    assert pool.free_pages >= 0
+    pool.free(2)
+    assert pool.cached_pages == 2  # parked warm again, full circle
+
+
+def test_host_store_byte_budget_rejects_and_evicts():
+    """The budget is a hard bound: an entry bigger than the whole budget
+    is rejected outright, and capacity pressure drops OLDEST entries
+    first (LRU) with exact byte accounting throughout."""
+    store = HostPageStore(128)
+    assert not store.put(("a",), "huge", 200)  # > budget: rejected
+    assert store.put(("a",), "pa", 64) and store.put(("b",), "pb", 64)
+    assert store.nbytes == 128 and len(store) == 2
+    assert store.get(("b",), ) == "pb"  # refreshes ("b",)'s LRU slot
+    assert store.revived_pages == 1 and store.nbytes == 128
+    assert store.put(("c",), "pc", 64)  # evicts ("a",) — now the oldest
+    assert ("a",) not in store and ("b",) in store and ("c",) in store
+    assert store.evicted_pages == 1 and store.nbytes == 128
+    assert store.pop(("b",)) == "pb"  # explicit invalidation
+    assert store.nbytes == 64 and store.revived_pages == 1
+    store.check_invariants()
 
 
 def test_pagepool_share_revive_evict_exact():

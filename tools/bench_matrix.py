@@ -14,6 +14,20 @@ and seed, so their losses must agree (the dp-vs-single math check).
     python tools/bench_matrix.py                    # 8-device virtual CPU grid
     python tools/bench_matrix.py --devices 1        # one real chip
     python tools/bench_matrix.py --out grid.json --steps 8
+
+Serving-tuning mode (``--serving-tuning``, ROADMAP item 3c: the PR 10
+residual tuning debts, auto-banked the first hardware window that runs
+this): instead of the training grid, drive ``tools/bench_serving.py``
+through (a) the paged-cache PAGE-SIZE sweep (``--page-sizes``, default
+16,32,64 — the DMA-tile tradeoff the correctness-tuned 16 ignores) and
+(b) an INT8 flash-decode ``FLEETX_DECODE_BLOCK_K`` retune
+(``--block-k``, default 128,256,512 — the int8 native tile is (32,128),
+so the bf16-tuned block may be wrong), one subprocess per case, each
+case's byte/tolerance parity asserted by the bench itself. The summary
+names the winning page size and block_k; ``--out`` banks the whole
+grid.
+
+    BENCH_MATRIX_PLATFORM=tpu python tools/bench_matrix.py --serving-tuning
 """
 
 from __future__ import annotations
@@ -161,6 +175,103 @@ def run_case(name, overrides, args, data_prefix, tmp):
     return record
 
 
+def _run_bench_serving(env_extra, timeout):
+    """One ``tools/bench_serving.py`` subprocess; returns its JSON
+    records keyed by metric name (None on failure, with the log tail)."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "bench_serving.py")]
+    env = dict(os.environ)
+    env["FLEETX_LOG_LEVEL"] = "ERROR"  # keep stdout JSON-parseable
+    if os.environ.get("BENCH_MATRIX_PLATFORM", "cpu") == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_PLATFORM"] = "cpu"
+    env.update(env_extra)
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, f"[bench_matrix] bench_serving timed out after {timeout}s"
+    if proc.returncode != 0:
+        return None, (proc.stdout + proc.stderr)[-2000:]
+    records = {}
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "metric" in rec:
+                records[rec["metric"]] = rec
+    return records, None
+
+
+def run_serving_tuning(args):
+    """The PR 10 residual tuning debts as grid cases (module docstring):
+    page-size sweep + int8 flash-decode block_k retune, each a
+    bench_serving subprocess whose parity gates must hold. Returns one
+    record per case."""
+    results = []
+    sizes = args.page_sizes.strip()
+    if sizes:
+        records, err = _run_bench_serving(
+            {"BENCH_SERVING_PAGE_SIZES": sizes}, args.timeout)
+        rec = (records or {}).get("gpt_345m_serving_page_sweep")
+        ok = err is None and rec is not None and rec["detail"]["parity"]
+        out = {"case": f"PageSweep[{sizes}]", "ok": bool(ok)}
+        if rec is not None:
+            out.update({
+                "best_page_size": rec["detail"]["best_page_size"],
+                "tokens_per_s": rec["value"],
+                "sweep": rec["detail"]["sweep"],
+            })
+        if err is not None:
+            out["log_tail"] = err
+        results.append(out)
+    # each block_k case runs the full bench_serving suite and reads only
+    # its int8 record — wasteful-looking, but the int8 record's
+    # speedup/parity fields are computed AGAINST that same run's bf16
+    # continuous baseline, so the suite is the unit of comparison; a
+    # tuning window pays minutes, not hours
+    for bk in (s.strip() for s in args.block_k.split(",") if s.strip()):
+        records, err = _run_bench_serving(
+            {"FLEETX_DECODE_BLOCK_K": bk}, args.timeout)
+        rec = (records or {}).get("gpt_345m_serving_int8")
+        # the int8 record's own tolerance-parity assertion is the gate:
+        # a block_k that breaks decode correctness fails its subprocess
+        ok = err is None and rec is not None and rec["detail"]["parity"]
+        out = {"case": f"Int8BlockK{bk}", "ok": bool(ok),
+               "block_k": int(bk)}
+        if rec is not None:
+            out.update({
+                "tokens_per_s": rec["value"],
+                "speedup_vs_bf16": rec["detail"].get("speedup_vs_bf16"),
+                "decode_bytes_per_token_int8":
+                    rec["detail"].get("decode_bytes_per_token_int8"),
+            })
+        if err is not None:
+            out["log_tail"] = err
+        results.append(out)
+    return results
+
+
+def _serving_tuning_summary(results):
+    failures = [r["case"] for r in results if not r["ok"]]
+    block_cases = [r for r in results
+                   if r["ok"] and r["case"].startswith("Int8BlockK")]
+    best_bk = (max(block_cases, key=lambda r: r["tokens_per_s"])["block_k"]
+               if block_cases else None)
+    sweep = next((r for r in results
+                  if r["ok"] and r["case"].startswith("PageSweep")), None)
+    return {
+        "metric": "bench_matrix_serving_tuning",
+        "cases": len(results),
+        "passed": sum(r["ok"] for r in results),
+        "failed_cases": failures,
+        "best_page_size": sweep["best_page_size"] if sweep else None,
+        "best_int8_block_k": best_bk,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--devices", type=int, default=8,
@@ -173,7 +284,30 @@ def main(argv=None):
                     help="max relative final-loss divergence vs the first "
                          "case (same data+seed => same math)")
     ap.add_argument("--out", default=None, help="write the grid json here")
+    ap.add_argument("--serving-tuning", action="store_true",
+                    help="run the serving tuning grid (page-size sweep + "
+                         "int8 block_k retune) instead of the training grid")
+    ap.add_argument("--page-sizes", default="16,32,64",
+                    help="paged-cache page sizes to sweep (empty = skip)")
+    ap.add_argument("--block-k", default="128,256,512",
+                    help="FLEETX_DECODE_BLOCK_K values for the int8 "
+                         "flash-decode retune (empty = skip)")
     args = ap.parse_args(argv)
+
+    if args.serving_tuning:
+        results = run_serving_tuning(args)
+        for rec in results:
+            print(json.dumps(rec))
+        summary = _serving_tuning_summary(results)
+        print(json.dumps(summary))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"summary": summary, "results": results}, f,
+                          indent=2)
+        if summary["failed_cases"]:
+            raise SystemExit(
+                f"serving tuning failed: {summary['failed_cases']}")
+        return
 
     grids = cases_by_devices()
     try:

@@ -41,6 +41,20 @@ every request's stream must share at least 75% of its leading tokens
 with the bf16 run (`parity` + `parity_prefix_frac_min`; byte parity is
 deliberately NOT required — that is the bf16 contract).
 
+A sixth record (`chunked`) prices CHUNKED PREFILL (docs/SERVING.md): a
+long-prompt mixed workload run with and without
+`FLEETX_SERVING_PREFILL_CHUNK`, reporting decode TPOT p50/p99 (inter-
+token gaps observed through `on_token` callbacks — the latency long
+arriving prompts hold hostage) both ways with byte parity asserted, plus
+the engine's `prefill_stall_ms` percentiles: with chunking on, no tick
+stalls decode longer than ~one chunk-sized prefill call. Its
+`detail.spill` sub-report runs an OVERSUBSCRIBED shared-prefix workload
+(hot prefix set > device page pool) with the host-DRAM spill tier on vs
+off: without it LRU eviction destroys every warm prefix (hit rate
+collapses on revisit), with it spilled pages revive from host DRAM and
+the hit rate holds — byte parity asserted, spill/revive/byte counters
+reported.
+
 `BENCH_SERVING_PAGE_SIZES=16,32,64` appends a page-size sweep record
 (`page_sweep`): the continuous workload re-run per page size so a TPU
 window can pick a DMA-tuned default over the correctness-tuned 16
@@ -123,6 +137,138 @@ def _shared_prefix_workload(n: int):
             [prefix, rng.randint(0, VOCAB, tail).astype(np.int32)])
         out.append((prompt, int(gen)))
     return out
+
+
+def _chunked_workload(n: int):
+    """Long-prompt mixed load: alternating near-max prompts and short
+    ones, so long arrivals keep landing while earlier requests decode —
+    the TPOT-hostage shape chunked prefill exists for."""
+    rng = np.random.RandomState(2)
+    long_len = PROMPT_RANGE[1]
+    short_len = max(PROMPT_RANGE[0], 3)
+    out = []
+    for i in range(n):
+        plen = long_len if i % 2 == 0 else short_len
+        gen = rng.randint(GEN_RANGE[0], GEN_RANGE[1] + 1)
+        out.append((rng.randint(0, VOCAB, plen).astype(np.int32), int(gen)))
+    return out
+
+
+def _run_continuous_tpot(engine, workload):
+    """_run_continuous with per-token host timestamps: returns (tokens,
+    detail) where detail carries decode TPOT percentiles — the
+    inter-token gap every active stream observes, the number a long
+    arriving prompt's prefill inflates."""
+    from fleetx_tpu.serving.metrics import ServingMetrics
+
+    engine.metrics = ServingMetrics(engine.slots)
+    engine._publish_quant_metrics()
+    stamps = {}
+
+    def on_token(rid, tok, finished):
+        stamps.setdefault(rid, []).append(time.perf_counter())
+
+    t0 = time.perf_counter()
+    rids = [engine.submit(p, max_length=g, on_token=on_token)
+            for p, g in workload]
+    res = engine.drain()
+    elapsed = time.perf_counter() - t0
+    gaps = []
+    for ts in stamps.values():
+        gaps += [b - a for a, b in zip(ts, ts[1:])]
+    arr = np.asarray(gaps, np.float64) * 1e3
+    snap = engine.metrics.snapshot()
+    detail = {
+        "requests": len(workload),
+        "slots": engine.slots,
+        "useful_tokens": sum(g for _, g in workload),
+        "elapsed_s": round(elapsed, 3),
+        "queue_depth_mean": round(snap["queue_depth_mean"], 2),
+        "slot_occupancy_mean": round(snap["slot_occupancy_mean"], 3),
+        "ttft_ms_mean": round(snap["ttft_ms_mean"], 2),
+        "ttft_ms_p50": round(snap["ttft_ms_p50"], 2),
+        "ttft_ms_p95": round(snap["ttft_ms_p95"], 2),
+        "tpot_ms_p50": round(float(np.percentile(arr, 50)), 2),
+        "tpot_ms_p99": round(float(np.percentile(arr, 99)), 2),
+        "tpot_ms_max": round(float(arr.max()), 2),
+        "prefill_chunks": snap["prefill_chunks"],
+        "prefill_stall_ms_p50": (
+            None if snap["prefill_stall_ms_p50"] is None
+            else round(snap["prefill_stall_ms_p50"], 2)),
+        "prefill_stall_ms_p99": (
+            None if snap["prefill_stall_ms_p99"] is None
+            else round(snap["prefill_stall_ms_p99"], 2)),
+        "prefill_stall_ms_max": (
+            None if snap["prefill_stall_ms_max"] is None
+            else round(snap["prefill_stall_ms_max"], 2)),
+    }
+    return [np.asarray(res[r].tokens) for r in rids], detail
+
+
+def _spill_report(model, variables, gen_cfg, slots):
+    """The host-tier sub-benchmark: an oversubscribed shared-prefix
+    workload (hot prefix set exceeds the device page pool, every revisit
+    finds its warm pages evicted) run with the spill tier OFF then ON —
+    same submissions, byte parity asserted. OFF collapses the prefix hit
+    rate; ON sustains it out of host DRAM."""
+    from fleetx_tpu.serving import ServingEngine
+
+    page_size = 8 if _TINY else 16
+    cache_len = model.cfg.max_position_embeddings
+    cache_len += -cache_len % page_size
+    lane_pages = cache_len // page_size
+    # the smallest legal pool — one full lane + the trash page — so the
+    # hot prefix set cannot stay device-resident across revisits: the
+    # device tier is oversubscribed by construction
+    num_pages = lane_pages + 1
+    n_prefixes = 3  # > what the pool can park warm, even at TINY sizes
+    rounds = 2
+    rng = np.random.RandomState(4)
+    prefixes = [rng.randint(0, VOCAB, PREFIX_LEN).astype(np.int32)
+                for _ in range(n_prefixes)]
+    tail_max = max(PROMPT_RANGE[1] - PREFIX_LEN, 1)
+    reqs = []
+    for i in range(rounds * n_prefixes):
+        tail = rng.randint(1, tail_max + 1)
+        prompt = np.concatenate(
+            [prefixes[i % n_prefixes],
+             rng.randint(0, VOCAB, tail).astype(np.int32)])
+        reqs.append((prompt, int(rng.randint(GEN_RANGE[0],
+                                             GEN_RANGE[1] + 1))))
+
+    def run(host_bytes):
+        eng = ServingEngine(
+            model, variables, slots=slots, cache_len=cache_len,
+            gen_cfg=gen_cfg, paged=True, page_size=page_size,
+            num_pages=num_pages, prefill_bucket=8 if _TINY else 32,
+            host_cache_bytes=host_bytes)
+        toks = []
+        for prompt, gen in reqs:  # sequential: each revisit sees the
+            rid = eng.submit(prompt, max_length=gen)  # pool at rest
+            toks.append(np.asarray(eng.drain()[rid].tokens))
+        eng.cache_manager.pool.check_invariants()
+        return eng.metrics.snapshot(), toks
+
+    off_snap, off_toks = run(0)
+    on_snap, on_toks = run(1 << 30)
+    assert all(np.array_equal(a, b) for a, b in zip(off_toks, on_toks)), (
+        "host-tier revival broke byte parity vs cold prefill")
+    assert on_snap["host_revived_pages"] > 0, (
+        "spill workload never revived a page (pool not oversubscribed?)")
+    return {
+        "prefixes": n_prefixes,
+        "rounds": rounds,
+        "pages_total": num_pages - 1,
+        "parity": True,
+        "prefix_hit_rate_host_off": round(off_snap["prefix_hit_rate"], 3),
+        "prefix_hit_rate_host_on": round(on_snap["prefix_hit_rate"], 3),
+        "prefill_tokens_saved_host_off": off_snap["prefill_tokens_saved"],
+        "prefill_tokens_saved_host_on": on_snap["prefill_tokens_saved"],
+        "host_spilled_pages": on_snap["host_spilled_pages"],
+        "host_revived_pages": on_snap["host_revived_pages"],
+        "host_evicted_pages": on_snap["host_evicted_pages"],
+        "host_cache_bytes": on_snap["host_cache_bytes"],
+    }
 
 
 def _decode_bytes_per_token(engine):
@@ -382,6 +528,49 @@ def serving_records(n_requests: int = N_REQUESTS, slots: int = SLOTS):
     int8_tps = int8_detail["useful_tokens"] / int8_detail["elapsed_s"]
     int8_detail["speedup_vs_bf16"] = round(int8_tps / clean_tps, 3)
 
+    # chunked mode: long-prompt mixed workload with vs without chunked
+    # prefill — the TPOT p50/p99 delta is the decode-stall story, byte
+    # parity proves chunking only reschedules WHEN prompts ingest
+    ck_workload = _chunked_workload(n_requests)
+    chunk = 4 if _TINY else max(PROMPT_RANGE[1] // 4, 32)
+
+    def chunked_engine(prefill_chunk):
+        return ServingEngine(model, variables, slots=slots,
+                             cache_len=model.cfg.max_position_embeddings,
+                             gen_cfg=gen_cfg,
+                             prefill_bucket=8 if _TINY else 32,
+                             prefill_chunk=prefill_chunk)
+
+    base_eng = chunked_engine(0)
+    if not _TINY:  # TINY only schema-checks: compile time in the TPOT
+        _run_continuous(base_eng, ck_workload)  # numbers is acceptable
+    base_toks, base_detail = _run_continuous_tpot(base_eng, ck_workload)
+    ck_eng = chunked_engine(chunk)
+    if not _TINY:
+        _run_continuous(ck_eng, ck_workload)  # compile warmup
+    ck_toks, ck_detail = _run_continuous_tpot(ck_eng, ck_workload)
+    # chunking must not move a single byte of any stream
+    ck_detail["parity"] = all(
+        np.array_equal(a, b) for a, b in zip(base_toks, ck_toks))
+    assert ck_detail["parity"], "chunked prefill broke greedy byte parity"
+    assert ck_detail["prefill_chunks"] > 0, (
+        "chunked bench never ran a chunk (prompts shorter than the chunk?)")
+    ck_detail["prefill_chunk"] = chunk
+    ck_detail["unchunked"] = {
+        k: base_detail[k]
+        for k in ("tpot_ms_p50", "tpot_ms_p99", "tpot_ms_max",
+                  "ttft_ms_p50", "ttft_ms_p95", "prefill_stall_ms_p99",
+                  "prefill_stall_ms_max", "elapsed_s")}
+    # the headline claim: with chunking, the WORST decode stall a tick
+    # can suffer is ~one chunk-sized prefill, not a whole-prompt one
+    # (ratio < 1 on any host once prompts outgrow the chunk; noise can
+    # blur it at TINY sizes, so the record reports rather than asserts)
+    ck_detail["tpot_p99_ratio_vs_unchunked"] = round(
+        ck_detail["tpot_ms_p99"] / max(base_detail["tpot_ms_p99"], 1e-9), 3)
+    ck_detail["spill"] = _spill_report(model, variables, gen_cfg, slots)
+    ck_detail["dead_token_frac"] = 0.0
+    ck_detail["generated_tokens"] = ck_detail["useful_tokens"]
+
     # shared-prefix mode: paged engine, trie-cold warmup then warm timing
     sp_workload = _shared_prefix_workload(n_requests)
     sp_engine = ServingEngine(model, variables, slots=slots,
@@ -404,7 +593,8 @@ def serving_records(n_requests: int = N_REQUESTS, slots: int = SLOTS):
              ("continuous", cont_detail),
              ("shared_prefix", sp_detail),
              ("faulted", fault_detail),
-             ("int8", int8_detail)]
+             ("int8", int8_detail),
+             ("chunked", ck_detail)]
 
     # page-size sweep (ROADMAP item 1 follow-up): opt-in via
     # BENCH_SERVING_PAGE_SIZES so a TPU window can pick a DMA-tuned

@@ -19,7 +19,15 @@ per scenario, non-zero exit on any failure:
 - ``serving_hang``: a hung tick trips the FLEETX_SERVING_TICK_TIMEOUT_S
   watchdog, diagnostics are banked, recovery keeps parity;
 - ``serving_drain``: shutdown() under load returns EVERY request with a
-  terminal finish_reason (partials kept) and rejects new submits.
+  terminal finish_reason (partials kept) and rejects new submits;
+- ``serving_spill``: the two-level page cache under a mid-chunk fault —
+  a warm prefix spills to the host-DRAM tier under pool pressure, a
+  chunked-prefill request reviving it is killed mid-chunk, the tick
+  rolls back and recovery requeues it, and the HOST TIER SURVIVES: the
+  replayed request revives the same spilled pages again (inclusive
+  store) and finishes byte-identical to one-shot ``generate()``
+  (page_spill / page_revive / tick_fault / engine_recovery events
+  asserted).
 
 Usage::
 
@@ -427,6 +435,88 @@ def scenario_serving_drain(tmp):
             "shutdown + drain_reject events banked")
 
 
+def scenario_serving_spill(tmp):
+    """Mid-chunk fault over the two-level page cache: rollback +
+    requeue, host tier survives, revived pages reused, byte parity."""
+    import numpy as np
+
+    from fleetx_tpu.models.gpt.generation import GenerationConfig
+    from fleetx_tpu.models.gpt.model import GPTConfig, GPTForPretraining
+    from fleetx_tpu.obs import get_event_log
+    from fleetx_tpu.resilience.faults import faults
+    from fleetx_tpu.serving import ServingEngine
+
+    import jax
+    import jax.numpy as jnp
+
+    cfg = GPTConfig(
+        vocab_size=61, hidden_size=32, num_layers=1, num_attention_heads=2,
+        ffn_hidden_size=64, max_position_embeddings=64,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        dtype=jnp.float32, use_flash_attention=False)
+    gen_cfg = GenerationConfig(decode_strategy="greedy", eos_token_id=10**6,
+                               pad_token_id=60, max_length=4)
+    model = GPTForPretraining(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))
+    # smallest legal pool (4 usable pages) + chunked prefill + host tier
+    eng = ServingEngine(model, params, slots=2, cache_len=32, gen_cfg=gen_cfg,
+                        prefill_bucket=4, paged=True, page_size=8,
+                        num_pages=5, prefill_chunk=6,
+                        host_cache_bytes=1 << 20)
+    rng = np.random.RandomState(5)
+    sys_a = rng.randint(1, 61, (16,)).astype(np.int32)
+    sys_b = rng.randint(1, 61, (16,)).astype(np.int32)
+    # populate A's prefix pages, then force their eviction -> host spill
+    for pre in (sys_a, sys_b):
+        p = np.concatenate([pre, rng.randint(1, 61, (3,))]).astype(np.int32)
+        eng.submit(p, max_length=4)
+        eng.drain()
+    ev = get_event_log()
+    assert ev.find("page_spill"), "pool pressure never spilled a page"
+    store = eng._host_store
+    assert len(store) > 0 and store.spilled_pages > 0
+    # the victim: an A-prefixed prompt whose suffix chunks (10 > 6);
+    # its alloc revives A from host, then its FINAL chunk is killed
+    victim = np.concatenate(
+        [sys_a, rng.randint(1, 61, (10,))]).astype(np.int32)
+    want = _run_workload(  # byte-parity reference from a clean engine
+        ServingEngine(model, params, slots=2, cache_len=32, gen_cfg=gen_cfg,
+                      prefill_bucket=4, paged=True, page_size=8,
+                      num_pages=5, prefill_chunk=6,
+                      host_cache_bytes=1 << 20), [victim], 4)[0][0]
+    revived_before = store.revived_pages
+    faults.configure(prefill_raise=str(eng._fault_prefills + 1))
+    try:
+        rid = eng.submit(victim, max_length=4)
+        res = eng.drain()
+    finally:
+        faults.reset()
+    assert eng.metrics.engine_recoveries == 1, eng.metrics.snapshot()
+    assert eng._host_store is store, "recovery replaced the host store"
+    assert eng.cache_manager.pool.host_store is store, \
+        "rebuilt pool not re-threaded onto the surviving host tier"
+    assert len(store) > 0, "host tier lost its entries across recovery"
+    # the replayed (requeued) request revived A's spilled pages AGAIN —
+    # once before the fault, once after recovery (inclusive store)
+    assert store.revived_pages >= revived_before + 4, (
+        f"revived {store.revived_pages} vs {revived_before} before: the "
+        "replayed request did not reuse the host tier")
+    assert np.array_equal(res[rid].tokens, want), \
+        "tokens diverged after mid-chunk fault + host-tier revival"
+    eng.cache_manager.pool.check_invariants()
+    assert ev.find("page_revive"), "revival left no structured event"
+    fault_evs = ev.find("tick_fault")
+    assert fault_evs and fault_evs[-1].attrs["during_prefill"], \
+        "the injected fault was not banked as a prefill-phase tick_fault"
+    assert ev.find("engine_recovery"), "recovery left no structured event"
+    m = eng.metrics.snapshot()
+    return ("mid-chunk fault rolled back; host tier survived recovery "
+            f"(spilled={m['host_spilled_pages']} "
+            f"revived={m['host_revived_pages']} "
+            f"bytes={m['host_cache_bytes']}); replayed request reused "
+            "revived pages, byte parity held, events banked")
+
+
 SCENARIOS = {
     "sentry": scenario_sentry,
     "ckpt": scenario_ckpt,
@@ -435,6 +525,7 @@ SCENARIOS = {
     "serving_poison": scenario_serving_poison,
     "serving_hang": scenario_serving_hang,
     "serving_drain": scenario_serving_drain,
+    "serving_spill": scenario_serving_spill,
 }
 
 
