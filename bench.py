@@ -53,6 +53,30 @@ def _acquire_devices_or_die(timeout_s: int):
 _PEAK_SEEN = [0]
 
 
+def _overlap_detail(trainer) -> dict:
+    """The overlap-lever state of one training record: ZeRO update
+    sharding on/off (+ resident opt-state bytes), the XLA overlap flag
+    set, and the virtual-pp schedule — None unless this config actually
+    ran a virtual pipeline (docs/PERFORMANCE.md)."""
+    from fleetx_tpu.parallel.pipeline import stream_chunks_default
+    from fleetx_tpu.utils.xla_flags import overlap_flags_state
+
+    model_cfg = trainer.cfg.get("Model") or {}
+    v = model_cfg.get("virtual_pp_degree") or 1
+    if trainer.mesh_cfg.pp <= 1 or v <= 1:
+        schedule = None
+    else:
+        stream = model_cfg.get("virtual_pp_stream")
+        stream = stream_chunks_default() if stream is None else bool(stream)
+        schedule = "streamed" if stream else "sequential"
+    return {
+        "zero_update": bool(trainer._zero_update),
+        "opt_state_bytes_per_device": trainer.opt_state_device_bytes(),
+        "xla_flags": overlap_flags_state(),
+        "virtual_pp_schedule": schedule,
+    }
+
+
 def train_record(batch: int, *, seq: int, steps: int, warmup: int,
                  recompute: bool, granularity: str) -> dict:
     """Build the 345M trainer at ``batch`` and time ``steps`` train steps."""
@@ -74,11 +98,14 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
         ),
         Model=AttrDict(
             module="GPTModule",
-            vocab_size=50304,
-            hidden_size=1024,
-            num_layers=24,
-            num_attention_heads=16,
-            ffn_hidden_size=4096,
+            # model dims are env-overridable ONLY so harnesses (e.g.
+            # bench_matrix --train-tuning smoke on CPU) can shrink the
+            # model; the anchor record always runs the 345M defaults
+            vocab_size=int(os.environ.get("BENCH_VOCAB", 50304)),
+            hidden_size=int(os.environ.get("BENCH_HIDDEN", 1024)),
+            num_layers=int(os.environ.get("BENCH_LAYERS", 24)),
+            num_attention_heads=int(os.environ.get("BENCH_HEADS", 16)),
+            ffn_hidden_size=int(os.environ.get("BENCH_FFN", 4096)),
             max_position_embeddings=seq,
             # overridable for perf triage (e.g. quantifying the in-kernel
             # attention-dropout cost); the anchor keeps the reference's 0.1
@@ -121,10 +148,11 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
     module = build_module(cfg)
     trainer = Trainer(cfg, module)
     gbs = cfg.Global.global_batch_size
+    vocab = cfg.Model.vocab_size
     rng = np.random.RandomState(0)
     host_batch = {
-        "tokens": rng.randint(0, 50304, (gbs, seq)).astype(np.int32),
-        "labels": rng.randint(0, 50304, (gbs, seq)).astype(np.int32),
+        "tokens": rng.randint(0, vocab, (gbs, seq)).astype(np.int32),
+        "labels": rng.randint(0, vocab, (gbs, seq)).astype(np.int32),
         "loss_mask": np.ones((gbs, seq), np.float32),
     }
     trainer.init_state(host_batch)
@@ -189,6 +217,9 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
             "flops_accounting": "model-flops (remat forward excluded)",
             "recompute": f"{recompute}:{granularity}",
             "baseline": "A100-40G 16260 tokens/s (reference single_card.md)",
+            # overlap attribution (ISSUE 12): which step-overlap levers
+            # were live, so trajectory gains are attributable to them
+            "overlap": _overlap_detail(trainer),
         },
     }
     # feed the obs layer this record's numbers (gauges are last-writer-
@@ -247,6 +278,14 @@ def _child_bench_records(tool: str, label: str, timeout_s: int):
 
 
 def main():
+    # overlap flags must land in XLA_FLAGS before ANY backend init —
+    # here, before the probe/bench children (which inherit the env) and
+    # the parent's own device acquisition. The Trainer-ctor call would
+    # be too late (and now refuses to append post-init, keeping the
+    # detail.overlap report honest).
+    from fleetx_tpu.utils.xla_flags import apply_overlap_flags
+
+    apply_overlap_flags()
     # Fast tunnel probe (the proven tpu_watch.sh pattern): on a wedged
     # tunnel each stage would otherwise burn its own 300s guard serially
     # (decode child first, then the parent) — ~10 min to fail. A throwaway
@@ -281,6 +320,13 @@ def main():
                 f"wedged?); banking a CPU-interpret fallback record. "
                 f"probe stderr tail: {tail}\n")
             fallback = True
+            # the overlap flag set appended above is TPU-only; this same
+            # process is about to init a CPU backend, and a CPU-only
+            # jaxlib aborts on unknown --xla_tpu_* flags — which would
+            # kill the very fallback record this path exists to bank
+            from fleetx_tpu.utils.xla_flags import strip_overlap_flags
+
+            strip_overlap_flags()
             os.environ["BENCH_PLATFORM"] = "cpu"
             # shrink to host-feasible work (345M fwd+bwd on CPU)
             os.environ["BENCH_SEQ"] = os.environ.get(

@@ -35,10 +35,13 @@ from fleetx_tpu.optims.lr_scheduler import build_lr_scheduler
 from fleetx_tpu.optims.optimizer import build_optimizer
 from fleetx_tpu.parallel import env as dist_env
 from fleetx_tpu.parallel.mesh import DATA_AXES, MeshConfig, build_mesh, use_mesh
-from fleetx_tpu.parallel.sharding import make_rules, param_shardings
+from fleetx_tpu.parallel.sharding import (
+    make_rules, param_shardings, zero_update_spec,
+)
 from fleetx_tpu.resilience.faults import faults
 from fleetx_tpu.utils.hw import peak_flops_per_chip
 from fleetx_tpu.utils.log import logger
+from fleetx_tpu.utils.xla_flags import apply_overlap_flags
 
 __all__ = ["CheckpointUnrestorable", "SentryAbort", "Trainer", "TrainState"]
 
@@ -191,7 +194,23 @@ class Trainer:
 
         dist = cfg.Distributed or {}
         self.mesh_cfg = MeshConfig.from_dist_config(dist)
+        # comms/compute overlap flags must land in XLA_FLAGS before the
+        # backend initializes (build_mesh below touches devices); env-gated
+        # and TPU-only by default — see utils/xla_flags.py
+        apply_overlap_flags()
         self.mesh = build_mesh(self.mesh_cfg)
+        # ZeRO weight-update sharding (docs/PERFORMANCE.md "Training
+        # overlap", arxiv 2004.13336): reduce-scatter grads over the
+        # data-parallel axes, run optax + apply_updates + the sentry gnorm
+        # on the 1/N shard, all-gather updated params. On by default
+        # whenever a data-parallel axis exists; the optimizer state then
+        # LIVES sharded between steps (out_shardings), cutting its HBM by
+        # the dp*fsdp factor even at sharding stage 1/2.
+        self._zero_update = (
+            os.environ.get("FLEETX_ZERO_UPDATE", "1") == "1"
+            and self.mesh_cfg.dp * self.mesh_cfg.fsdp > 1
+        )
+        self._zero_param_shardings = None
         from fleetx_tpu.parallel.dap import dap_rules
 
         self.rules = make_rules(
@@ -262,15 +281,26 @@ class Trainer:
             "fleetx_train_mfu",
             "Model-FLOPs utilization: cost_analysis flops / step time / "
             "peak chip FLOPs")
+        self._obs_hbm_bytes = reg.gauge(
+            "fleetx_train_step_hbm_bytes",
+            "Compiled train step per-device HBM bytes accessed "
+            "(cost_analysis static estimate)")
+        self._obs_opt_bytes = reg.gauge(
+            "fleetx_train_opt_state_bytes",
+            "Optimizer-state bytes resident per device (ZeRO update "
+            "sharding shrinks this by the dp*fsdp factor)")
         # expose every instrument at zero immediately (matching the
         # serving metrics, whose children exist from __init__): a healthy
         # run must scrape as 0, not as absent-looking-like-broken
         for fam in (self._obs_steps, self._obs_sentry_skips,
                     self._obs_save_failures, self._obs_quarantines,
                     self._obs_loss, self._obs_lr, self._obs_step_time,
-                    self._obs_tokens_per_s, self._obs_mfu):
+                    self._obs_tokens_per_s, self._obs_mfu,
+                    self._obs_hbm_bytes, self._obs_opt_bytes):
             fam.labels()
         self._flops_per_step = None  # lazy; False = cost analysis failed
+        self._hbm_bytes_per_step = None  # same contract as _flops_per_step
+        self._cost_cache = {}  # name -> (abstract-args spec, cost dict)
 
     # ------------------------------------------------------------------ init
     def init_state(self, sample_batch: Dict[str, np.ndarray]) -> TrainState:
@@ -306,6 +336,7 @@ class Trainer:
             dict(self.mesh.shape),
         )
         self.n_params = n_params
+        self._obs_opt_bytes.set(float(self.opt_state_device_bytes()))
         resumable = False
         if os.path.isdir(os.path.join(self.output_dir, "checkpoints")):
             resumable = self._ckpt_manager().latest_step() is not None
@@ -344,6 +375,21 @@ class Trainer:
     def _state_shardings(self, abstract: TrainState):
         ps = param_shardings(abstract.params, self.mesh, self.rules)
 
+        if self._zero_update:
+            # weight-update shard layout of every param: the in-jit
+            # sharding constraints of the train step and (below) the
+            # resident layout of the optimizer state
+            flat_unboxed, treedef = jax.tree_util.tree_flatten(
+                _unbox(abstract.params))
+            zero_flat = [
+                NamedSharding(
+                    self.mesh,
+                    zero_update_spec(sh.spec, leaf.shape, self.mesh))
+                for leaf, sh in zip(flat_unboxed, jax.tree.leaves(ps))
+            ]
+            self._zero_param_shardings = jax.tree_util.tree_unflatten(
+                treedef, zero_flat)
+
         # Index param specs by their *tree path*, and match optimizer-state
         # leaves by path suffix: optax moment trees (mu/nu, ...) mirror the
         # param tree under transform-specific prefixes, so the param path is
@@ -381,7 +427,12 @@ class Trainer:
                     break
             if spec is None:
                 return NamedSharding(self.mesh, P(), **kind)
-            if self.mesh_cfg.sharding_stage in (1, 2) and self.mesh_cfg.fsdp > 1:
+            if self._zero_update:
+                # moments live on the weight-update shard (dp AND fsdp
+                # folded in) — strictly more sharded than the stage-1/2
+                # fsdp-only layout below
+                spec = zero_update_spec(spec, leaf.shape, self.mesh)
+            elif self.mesh_cfg.sharding_stage in (1, 2) and self.mesh_cfg.fsdp > 1:
                 spec = self._add_fsdp(spec, leaf.shape)
             return NamedSharding(self.mesh, spec, **kind)
 
@@ -427,6 +478,7 @@ class Trainer:
         sentry = self._sentry_enabled
         loss_max = self._sentry_loss_max
         gnorm_max = self._sentry_gnorm_max
+        zero_sh = self._zero_param_shardings if self._zero_update else None
 
         def train_step(state: TrainState, batch, rng):
             params = state.params
@@ -435,14 +487,34 @@ class Trainer:
             else:
                 loss, grads = grads_fn(params, batch, rng)
                 aux, new_extra = {}, None
+            raw_grads = _unbox(grads)
+            raw_params = _unbox(params)
+            if zero_sh is not None:
+                # ZeRO update sharding: constraining grads to the update-
+                # shard layout turns the dp/fsdp grad all-reduce into a
+                # reduce-scatter; params slice to the same shard (layout
+                # only, no comms), the whole optax chain + apply_updates
+                # then runs on 1/N elements per device, and the jit's
+                # replicated param out_shardings insert the all-gather —
+                # async under the latency-hiding scheduler (xla_flags.py),
+                # so it floats into the next step's forward.
+                raw_grads = jax.lax.with_sharding_constraint(
+                    raw_grads, zero_sh)
+                raw_params = jax.lax.with_sharding_constraint(
+                    raw_params, zero_sh)
             updates, new_opt = tx.update(
-                _unbox(grads), state.opt_state, _unbox(params)
+                raw_grads, state.opt_state, raw_params
             )
-            new_params_raw = optax.apply_updates(_unbox(params), updates)
+            new_params_raw = optax.apply_updates(raw_params, updates)
+            if zero_sh is not None:
+                # keep the post-update tree (and the sentry select below)
+                # on the shard; the gather happens once, at the jit edge
+                new_params_raw = jax.lax.with_sharding_constraint(
+                    new_params_raw, zero_sh)
             new_params = _rebox_like(new_params_raw, params)
             if new_extra is not None:
                 new_extra = module.post_update_extra(new_params_raw, new_extra)
-            gnorm = optax.global_norm(_unbox(grads))
+            gnorm = optax.global_norm(raw_grads)
             new_state = TrainState(
                 step=state.step + 1, params=new_params, opt_state=new_opt,
                 extra=new_extra,
@@ -515,7 +587,10 @@ class Trainer:
 
         jax.jit wrappers expose no cost_analysis; only the AOT Compiled object
         does. We recorded the abstract avals of the first real call, so
-        lower().compile() here is a compilation-cache hit, not a recompile."""
+        lower().compile() here is a compilation-cache hit, not a recompile —
+        but even a cache-hit relower costs milliseconds, so the result is
+        memoized per compiled-step signature (the recorded avals): the
+        per-step mfu/hbm gauges query the lowering exactly once."""
         import jax
 
         import flax.linen as nn
@@ -524,6 +599,9 @@ class Trainer:
         spec = self._abstract_args.get(name)
         if fn is None or spec is None:
             return None
+        cached = self._cost_cache.get(name)
+        if cached is not None and cached[0] is spec:
+            return cached[1]
         args, kwargs = spec
         # same contexts as _in_context: without the logical axis rules,
         # with_logical_constraint silently no-ops and we'd trace (and
@@ -534,6 +612,7 @@ class Trainer:
         # jax but a [dict]-per-computation list on older releases
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else None
+        self._cost_cache[name] = (spec, cost)
         return cost
 
     def _step_mfu(self, step_time_s: float) -> Optional[float]:
@@ -557,6 +636,35 @@ class Trainer:
             return None
         peak = peak_flops_per_chip(jax.devices()[0])
         return self._flops_per_step / max(step_time_s, 1e-9) / peak
+
+    def _step_hbm_bytes(self) -> Optional[float]:
+        """Compiled train step's per-device HBM bytes accessed (static
+        cost_analysis estimate) for the ``fleetx_train_step_hbm_bytes``
+        gauge — tried once, then cached, same contract as the flops."""
+        if self._hbm_bytes_per_step is None:
+            try:
+                cost = self.cost_analysis("train")
+                b = float((cost or {}).get("bytes accessed", 0.0) or 0.0)
+                self._hbm_bytes_per_step = b if b > 0 else False
+            except Exception:  # noqa: BLE001 — observability never aborts
+                self._hbm_bytes_per_step = False
+        return self._hbm_bytes_per_step or None
+
+    def opt_state_device_bytes(self) -> int:
+        """Optimizer-state bytes RESIDENT per device: per-leaf shard shape
+        x itemsize — the number the ZeRO update sharding shrinks by the
+        dp*fsdp factor (replicated leaves count full size)."""
+        total = 0
+        for leaf in jax.tree.leaves(self.state.opt_state):
+            if not (hasattr(leaf, "shape") and hasattr(leaf, "dtype")):
+                continue
+            sh = getattr(leaf, "sharding", None)
+            if sh is not None and hasattr(sh, "shard_shape"):
+                shape = sh.shard_shape(leaf.shape)
+            else:
+                shape = leaf.shape
+            total += int(np.prod(shape, dtype=np.int64)) * leaf.dtype.itemsize
+        return total
 
     def _in_context(self, fn, name=None):
         """Run calls (and hence first-call tracing) inside the mesh + logical
@@ -757,12 +865,15 @@ class Trainer:
                         ips_total = tokens_per_batch / dt
                         lr = float(self.lr_schedule(step))
                         mfu = self._step_mfu(dt)
+                        hbm = self._step_hbm_bytes()
                         self._obs_loss.set(float(losses))
                         self._obs_lr.set(lr)
                         self._obs_step_time.observe(dt)
                         self._obs_tokens_per_s.set(ips_total)
                         if mfu is not None:
                             self._obs_mfu.set(mfu)
+                        if hbm is not None:
+                            self._obs_hbm_bytes.set(hbm)
                         self.module.training_step_end(
                             {
                                 "epoch": epoch,
@@ -1069,6 +1180,7 @@ class Trainer:
                 saved_impl, self._dropout_impl(),
             )
         self._restored_step = step
+        self._obs_opt_bytes.set(float(self.opt_state_device_bytes()))
         logger.info("restored checkpoint step %d (epoch %d)", step, self.start_epoch)
 
     def _quarantine_step(self, step: int) -> None:

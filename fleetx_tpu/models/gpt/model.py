@@ -89,6 +89,10 @@ class GPTConfig:
     # non-contiguous layer chunks (reference num_virtual_pipeline_stages,
     # hybrid_model.py:1095)
     virtual_pp_degree: int = 1
+    # virtual-chunk schedule: True fuses the v chunk passes into one
+    # streamed scan (parallel/pipeline.py module docstring), False chains
+    # per-chunk scans; None resolves from FLEETX_VPP_STREAM (default on)
+    virtual_pp_stream: Optional[bool] = None
     balance_loss_weight: float = 0.01
     # decode kv-cache length; None = max_position_embeddings. Generation
     # drivers set this to prompt_len + max_length so per-step cache traffic
@@ -790,6 +794,7 @@ class GPTModel(nn.Module):
                 cfg.pp_degree,
                 max(cfg.num_microbatches, 1),
                 virtual_pp=max(cfg.virtual_pp_degree, 1),
+                stream=cfg.virtual_pp_stream,
                 name="layers",
             )(x, attn_mask, deterministic)
         if cfg.scan_layers and not selective:

@@ -161,7 +161,8 @@ def init_pipeline_params_via_sequential(nets, rng, tokens):
         is_leaf=is_box,
     )
     remapped = sequential_params_to_pipeline(
-        unboxed, gcfg.pp_degree, max(gcfg.virtual_pp_degree, 1)
+        unboxed, gcfg.pp_degree, max(gcfg.virtual_pp_degree, 1),
+        stream=getattr(gcfg, "virtual_pp_stream", None),
     )
     abstract = jax.eval_shape(lambda r: nets.init(r, tokens), rng)
     flat_abs = flax.traverse_util.flatten_dict(

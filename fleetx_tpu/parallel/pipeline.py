@@ -26,22 +26,39 @@ peak-memory class).
 
 Virtual/interleaved stages (reference ``num_virtual_pipeline_stages``,
 hybrid_model.py:1095): with ``virtual_pp=v`` each physical stage owns v
-non-contiguous layer chunks (stage p holds global chunks {p, p+pp, ...}),
-and a microbatch makes v passes through the stage ring — chunk pass j is
-its own scan with statically selected chunk parameters, chained on pass
-j-1's emission stream. The math matches the reference exactly; the timing
-differs by design: the reference's interleaved 1F1B is a *runtime*
-schedule (a rank hops between chunk kernels mid-stream), which a single
-statically-scheduled XLA program does not express. In this SPMD pipe the
-bubble shrinks by raising ``num_microbatches`` (cheap here — microbatches
-stream through one compiled scan, no host loop), while virtual stages
-keep their other role: finer-grained layer placement so each stage's
-weights/activations split v ways.
+layer chunks and a microbatch traverses the stage ring v times. Two
+schedules exist:
+
+- **streamed** (default, ``FLEETX_VPP_STREAM=1``): ONE scan over a
+  [v*pp, ...] state buffer — every chunk's stage applies in parallel each
+  tick, chunk j+1 consumes chunk j's emission stream at pp-tick skew
+  (row j*pp+pp-1 rolls straight into row (j+1)*pp), and the whole
+  computation drains once: M + v*pp - 1 ticks total instead of the
+  sequential schedule's v*(M + pp - 1). For M >> v*pp that is ~v x fewer
+  scan ticks (collective permutes, loop iterations, per-tick dispatch),
+  bought with dead-row work during the longer single fill/drain —
+  tools/bench_pp_bubble.py --virtual-pp measures the trade and gates it.
+  The param layout equals the plain pipe layout with v*pp stage rows
+  (row g holds global chunk g = layers [g*lpc, (g+1)*lpc)), so the
+  remap helpers and checkpoint converters need no new scopes.
+- **sequential** (``FLEETX_VPP_STREAM=0``): chunk pass j is its own scan
+  with statically selected chunk parameters, chained on pass j-1's
+  emission stream — pass j fully drains (pp-1 dead ticks) before pass
+  j+1 starts.
+
+Both match the reference's math exactly (same layer order per
+microbatch); the reference's interleaved 1F1B remains a *runtime*
+schedule that a single statically-scheduled XLA program does not
+express. Raising ``num_microbatches`` stays the primary bubble lever
+(microbatches stream through one compiled scan, no host loop), and
+virtual stages keep their other role: finer-grained layer placement so
+each stage's weights/activations split v ways.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+import os
+from typing import Any, Callable, Optional
 
 import jax.numpy as jnp
 from flax import linen as nn
@@ -51,7 +68,15 @@ __all__ = [
     "sequential_params_to_pipeline",
     "pipeline_params_to_sequential",
     "maybe_pipeline_params_to_sequential",
+    "stream_chunks_default",
 ]
+
+
+def stream_chunks_default() -> bool:
+    """Whether virtual-pp chunks run the fused streamed schedule (module
+    docstring). One resolution point so PipelinedStack, the param remap,
+    and the init-via-sequential path can never disagree on layout."""
+    return os.environ.get("FLEETX_VPP_STREAM", "1") == "1"
 
 _SEQ_PREFIX = "gpt/layers/layer/"
 _PIPE_PREFIX = "gpt/layers/pipe/stages/layers/layer/"
@@ -77,12 +102,22 @@ def _unflatten(flat, wrap):
     return {"params": tree} if wrap else tree
 
 
-def sequential_params_to_pipeline(variables, pp: int, virtual_pp: int = 1):
+def sequential_params_to_pipeline(variables, pp: int, virtual_pp: int = 1,
+                                  stream: Optional[bool] = None):
     """Remap a sequential-scan param tree (gpt/layers/layer/* with leading
     [num_layers] axis) to the pipeline layout: [pp, layers_per_stage]
-    leading axes under gpt/layers/pipe/... — or, with virtual stages, one
-    [pp, layers_per_chunk] tree per chunk pass, stage p of pass j holding
-    global chunk j*pp + p (the reference's interleaved chunk placement)."""
+    leading axes under gpt/layers/pipe/... — or, with virtual stages,
+    either the STREAMED layout (one [v*pp, layers_per_chunk] tree under
+    the same pipe scope, row g = global chunk g) or the sequential-chunk
+    layout (one [pp, layers_per_chunk] tree per chunk pass, stage p of
+    pass j holding global chunk j*pp + p — the reference's interleaved
+    chunk placement). ``stream=None`` resolves from FLEETX_VPP_STREAM so
+    the remap always matches what PipelinedStack will build."""
+    if stream is None:
+        stream = stream_chunks_default()
+    if virtual_pp > 1 and stream:
+        # streamed layout == the plain pipe layout with v*pp stage rows
+        return sequential_params_to_pipeline(variables, pp * virtual_pp, 1)
     flat, wrap = _flatten(variables)
     out = {}
     for k, val in flat.items():
@@ -213,13 +248,16 @@ class _PipelineTick(nn.Module):
 class PipelinedStack(nn.Module):
     """Drop-in decoder stack for pp>1. Input [b, s, h]; b is split into
     ``num_microbatches`` microbatches that stream through the stages
-    ``virtual_pp`` times (once per layer chunk)."""
+    ``virtual_pp`` times (once per layer chunk). ``stream`` selects the
+    fused one-scan virtual-chunk schedule (module docstring); None
+    resolves from FLEETX_VPP_STREAM."""
 
     cfg: Any
     layer_cls: Callable
     pp: int
     num_microbatches: int
     virtual_pp: int = 1
+    stream: Optional[bool] = None
 
     @nn.compact
     def __call__(self, x, attn_mask=None, deterministic=True):
@@ -248,25 +286,30 @@ class PipelinedStack(nn.Module):
             raise ValueError(f"batch {b} % num_microbatches {M} != 0")
         layers_per_stage = cfg.num_layers // (pp * v)
         mb = b // M
+        streamed = self.stream if self.stream is not None \
+            else stream_chunks_default()
+        # streamed schedule: one logical pipe of v*pp chunk rows, drained
+        # once; sequential schedule: v chained passes of pp rows each
+        rows = pp * v if (v > 1 and streamed) else pp
 
         micro = x.reshape(M, mb, s, h)
-        # pad the injection stream with pp-1 dead ticks to drain the pipe
-        pad = jnp.zeros((pp - 1, mb, s, h), x.dtype)
+        # pad the injection stream with rows-1 dead ticks to drain the pipe
+        pad = jnp.zeros((rows - 1, mb, s, h), x.dtype)
         inject_stream = jnp.concatenate([micro, pad], axis=0)
 
-        state0 = jnp.zeros((pp, mb, s, h), x.dtype)
+        state0 = jnp.zeros((rows, mb, s, h), x.dtype)
         if per_example:
             m = attn_mask.reshape((M, mb) + attn_mask.shape[1:])
-            m_pad = jnp.zeros((pp - 1,) + m.shape[1:], m.dtype)
+            m_pad = jnp.zeros((rows - 1,) + m.shape[1:], m.dtype)
             m_stream = jnp.concatenate([m, m_pad], axis=0)
-            m_state0 = jnp.zeros((pp,) + m.shape[1:], m.dtype)
+            m_state0 = jnp.zeros((rows,) + m.shape[1:], m.dtype)
             bcast_mask = None
         else:
             m_stream = None
             m_state0 = None
             bcast_mask = attn_mask
 
-        def chunk_pass(j, inj_stream):
+        def chunk_pass(name, inj_stream):
             tick = nn.scan(
                 _PipelineTick,
                 variable_broadcast="params",
@@ -275,19 +318,26 @@ class PipelinedStack(nn.Module):
                 in_axes=((0, 0 if per_example else nn.broadcast), nn.broadcast,
                          nn.broadcast),
                 out_axes=0,
-                length=M + pp - 1,
+                length=M + rows - 1,
             )
-            name = "pipe" if v == 1 else _VPIPE_SCOPE.format(j=j)
             _, emitted = tick(
-                cfg, self.layer_cls, pp, layers_per_stage, name=name
+                cfg, self.layer_cls, rows, layers_per_stage, name=name
             )((state0, m_state0), (inj_stream, m_stream), bcast_mask,
               deterministic)
-            # microbatch m exits the last stage at tick m + pp - 1
-            return emitted[pp - 1:]
+            # microbatch m exits the last row at tick m + rows - 1
+            return emitted[rows - 1:]
+
+        if rows != pp or v == 1:
+            # plain pipe (v == 1) and the streamed fusion share one scan
+            # AND one param scope: the streamed layout IS the plain layout
+            # with v*pp stage rows (row g = global chunk g), so checkpoint
+            # remaps need no extra scopes
+            out = chunk_pass("pipe", inject_stream)
+            return out.reshape(b, s, h)
 
         stream = inject_stream
         for j in range(v):
-            out = chunk_pass(j, stream)
+            out = chunk_pass(_VPIPE_SCOPE.format(j=j), stream)
             if j < v - 1:
                 stream = jnp.concatenate([out, pad], axis=0)
         return out.reshape(b, s, h)

@@ -20,6 +20,7 @@ logical names → mesh axes for a given parallelism configuration.
 
 from __future__ import annotations
 
+import math
 from typing import Any, List, Optional, Sequence, Tuple
 
 from flax import linen as nn
@@ -30,6 +31,7 @@ __all__ = [
     "logical_to_mesh_sharding",
     "param_shardings",
     "with_logical_constraint",
+    "zero_update_spec",
 ]
 
 Rules = Sequence[Tuple[str, Any]]
@@ -101,3 +103,49 @@ def param_shardings(abstract_vars, mesh: Mesh, rules: Rules):
 def with_logical_constraint(x, logical_axes: Tuple[Optional[str], ...]):
     """Annotate an activation with logical axes (no-op outside a mesh ctx)."""
     return nn.with_logical_constraint(x, P(*logical_axes))
+
+
+def _spec_axes(entry) -> Tuple[str, ...]:
+    """Mesh axes named by one PartitionSpec entry (str | tuple | None)."""
+    if entry is None:
+        return ()
+    if isinstance(entry, tuple):
+        return tuple(a for a in entry if a)
+    return (entry,)
+
+
+def zero_update_spec(spec: Optional[P], shape, mesh: Mesh,
+                     axes: Sequence[str] = ("dp", "fsdp")) -> P:
+    """PartitionSpec of one parameter's ZeRO *weight-update shard*
+    (arxiv 2004.13336: shard the optimizer update across the data-parallel
+    replicas, all-gather the result).
+
+    Folds the not-yet-used data-parallel mesh axes onto the first dimension
+    they divide evenly — on top of any existing tensor-parallel sharding, so
+    a dp x mp config shards the update dp ways *within* each mp shard. Tries
+    the full dp x fsdp product first (maximum shard factor), then each axis
+    alone. Leaves that no axis divides (tiny biases, scalars) keep their
+    original spec and stay replicated — correct, just not sharded."""
+    spec = spec if spec is not None else P()
+    if not getattr(shape, "__len__", None) or len(shape) == 0:
+        return spec
+    used = {a for entry in spec for a in _spec_axes(entry)}
+    free = [a for a in axes
+            if a in mesh.shape and mesh.shape[a] > 1 and a not in used]
+    if not free:
+        return spec
+    parts = list(spec) + [None] * (len(shape) - len(spec))
+    candidates = [tuple(free)]
+    if len(free) > 1:
+        candidates += [(a,) for a in free]
+    for cand in candidates:
+        factor = math.prod(int(mesh.shape[a]) for a in cand)
+        for i, dim in enumerate(shape):
+            cur = _spec_axes(parts[i])
+            cur_factor = math.prod(int(mesh.shape[a]) for a in cur)
+            if dim % (cur_factor * factor):
+                continue
+            merged = cur + cand
+            parts[i] = merged if len(merged) > 1 else merged[0]
+            return P(*parts)
+    return spec

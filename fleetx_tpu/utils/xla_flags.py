@@ -1,0 +1,138 @@
+"""XLA flag presets for comms/compute overlap (ROADMAP item 3a).
+
+The ZeRO weight-update sharding in `core/engine.py` expresses the train
+step as reduce-scatter(grads) -> shard-local update -> all-gather(params).
+XLA only *overlaps* those collectives with the surrounding compute when its
+latency-hiding scheduler and async-collective passes are on — without them
+the all-gather sits synchronously at the step tail and the sharding saves
+memory but no time. This module owns the flag set and the env-gated,
+idempotent application to ``XLA_FLAGS`` (flags are read once, at backend
+initialization, so `apply_overlap_flags` must run before the first jax
+device touch — the Trainer constructor and the CLI entry points call it).
+
+Gating (``FLEETX_XLA_OVERLAP``):
+
+- ``1``  — always append the flag set,
+- ``0``  — never,
+- unset — append only when a TPU backend is expected (JAX_PLATFORMS
+  mentions tpu/axon, or a TPU device file / TPU_NAME is present): the
+  flags are ``--xla_tpu_*``-heavy, and the CPU backend rejects unknown
+  flags loudly at init.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, MutableMapping, Optional
+
+__all__ = ["OVERLAP_FLAGS", "apply_overlap_flags", "overlap_flags_state",
+           "strip_overlap_flags"]
+
+# The MaxText/JAX-LLM lineage flag set: latency-hiding scheduler + async
+# collectives (all-gather / collective-permute / fusion), so the ZeRO
+# param all-gather and the pipeline's stage permutes float into adjacent
+# compute instead of serializing the step tail.
+OVERLAP_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_enable_async_collective_permute=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+)
+
+
+def _tpu_expected(env: MutableMapping[str, str]) -> bool:
+    """Best-effort 'will this process init a TPU backend?' without
+    importing jax (which would pin the backend before flags apply)."""
+    platforms = env.get("JAX_PLATFORMS", "").lower()
+    if "cpu" in platforms and "tpu" not in platforms:
+        return False
+    if "tpu" in platforms or "axon" in platforms:
+        return True
+    if env.get("TPU_NAME") or env.get("TPU_WORKER_ID"):
+        return True
+    try:
+        # /dev/accel0 is TPU-VM-specific; deliberately NOT /dev/vfio etc.
+        # (a CPU-only jaxlib aborts on unknown --xla_tpu_* flags, so a
+        # false positive here would be fatal, a false negative just slow)
+        return os.path.exists("/dev/accel0")
+    except OSError:  # pragma: no cover - exotic fs
+        return False
+
+
+def _backend_already_initialized() -> bool:
+    """True iff a jax backend has been created in this process (best
+    effort, never initializes one; private-API probes are guarded)."""
+    mods = __import__("sys").modules
+    jax = mods.get("jax")
+    if jax is None:
+        return False
+    try:
+        xb = jax._src.xla_bridge  # noqa: SLF001 - no public probe exists
+        if hasattr(xb, "backends_are_initialized"):
+            return bool(xb.backends_are_initialized())
+        return bool(getattr(xb, "_backends", None))
+    except Exception:  # pragma: no cover - jax internals moved
+        return False
+
+
+def apply_overlap_flags(
+    env: Optional[MutableMapping[str, str]] = None,
+) -> List[str]:
+    """Append the overlap flag set to ``env['XLA_FLAGS']`` (idempotent:
+    flags already present — under any value — are left alone so an
+    operator override wins). Returns the flags newly appended ([] when
+    gated off or nothing was missing)."""
+    env = os.environ if env is None else env
+    gate = env.get("FLEETX_XLA_OVERLAP", "")
+    if gate == "0":
+        return []
+    if gate != "1" and not _tpu_expected(env):
+        return []
+    if env is os.environ and _backend_already_initialized():
+        # XLA read XLA_FLAGS at backend init; appending now would be a
+        # silent no-op that overlap_flags_state() would then misreport
+        # as active. Leave the env alone so the report stays honest.
+        return []
+    current = env.get("XLA_FLAGS", "")
+    present = {f.split("=", 1)[0] for f in current.split() if f}
+    added = [f for f in OVERLAP_FLAGS if f.split("=", 1)[0] not in present]
+    if added:
+        env["XLA_FLAGS"] = (current + " " + " ".join(added)).strip()
+    return added
+
+
+def strip_overlap_flags(
+    env: Optional[MutableMapping[str, str]] = None,
+) -> List[str]:
+    """Remove every overlap-set flag (by name, any value) from
+    ``env['XLA_FLAGS']``. For flows that appended the TPU flag set and
+    then fell back to a CPU backend in the SAME process (bench.py's
+    wedged-tunnel fallback): a CPU-only jaxlib can abort on unknown
+    ``--xla_tpu_*`` flags, so they must be gone before that backend
+    initializes. Returns the removed flags."""
+    env = os.environ if env is None else env
+    names = {f.split("=", 1)[0] for f in OVERLAP_FLAGS}
+    kept, removed = [], []
+    for f in env.get("XLA_FLAGS", "").split():
+        (removed if f.split("=", 1)[0] in names else kept).append(f)
+    if removed:
+        env["XLA_FLAGS"] = " ".join(kept)
+    return removed
+
+
+def overlap_flags_state(
+    env: Optional[MutableMapping[str, str]] = None,
+) -> dict:
+    """Observability snapshot for bench records: gate value + which of the
+    overlap flags are live in XLA_FLAGS right now."""
+    env = os.environ if env is None else env
+    present = {f.split("=", 1)[0]
+               for f in env.get("XLA_FLAGS", "").split() if f}
+    return {
+        "gate": env.get("FLEETX_XLA_OVERLAP", "") or "auto",
+        "active": [f for f in OVERLAP_FLAGS
+                   if f.split("=", 1)[0] in present],
+    }

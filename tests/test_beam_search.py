@@ -196,7 +196,9 @@ def test_generate_dispatches_beam(model_and_params):
     assert out.shape == (4, 7)  # [b*nret, prompt+max]
 
 
+@pytest.mark.slow  # 6.7s baseline (PR 12 tier-1 budget audit): left-pad
 def test_left_padded_prompt_matches_unpadded_beam(model_and_params):
+    # parity stays tier-1 on the greedy/sampling decode suites
     """Beam search with a left-padded masked prompt must return the same
     continuations as the unpadded prompt (beam_search.py's pad handling)."""
     import numpy as np

@@ -117,7 +117,9 @@ def test_fast_dropout_false_restores_nn_dropout():
     assert isinstance(dropout_layer(0.1, "d", True), HashDropout)
 
 
+@pytest.mark.slow  # 6.8s baseline (PR 12 tier-1 budget audit): the
 def test_fast_dropout_false_end_to_end():
+    # nn.Dropout-vs-hash equivalence units stay tier-1
     """The nn.Dropout rollback path still trains (GPT forward+backward)."""
     from fleetx_tpu.models.gpt.model import GPTConfig, GPTForPretraining
 

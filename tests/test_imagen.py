@@ -102,7 +102,9 @@ def test_ddpm_sampler_shapes():
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow  # 11.5s baseline (PR 12 tier-1 budget audit): the export
 def test_imagen_export_serving_contract(tmp_path):
+    # serving-contract machinery stays tier-1 on the GPT export tests
     """Non-LM export: ImagenModule's serving_forward hook must carry the
     extra timestep input through the artifact."""
     from fleetx_tpu.models import build_module

@@ -413,7 +413,10 @@ def test_paged_manager_lane_lifecycle(model_and_params):
 
 # --------------------------------------------------------- parity contracts
 
+@pytest.mark.slow  # 25.1s baseline (PR 12 tier-1 budget audit): paged-vs-
 def test_paged_vs_slot_staggered_parity(model_and_params):
+    # slot byte parity stays tier-1 via test_chunked_serving's paged gate
+    # + test_serving_recovery's paged replay parity
     """The acceptance gate, compact: paged serving == slot serving ==
     one-shot generate(), byte-identical greedy tokens, under mixed prompt
     lengths, staggered admission, and lane reuse (slots=2, 5 requests —
@@ -447,7 +450,10 @@ def test_paged_vs_slot_staggered_parity(model_and_params):
 
 # ------------------------------------------------------------ the paged wins
 
+@pytest.mark.slow  # 33.1s baseline (PR 12 tier-1 budget audit): the
 def test_prefix_reuse_cuts_prefill_and_pages(model_and_params):
+    # prefix-hit/parity contract stays tier-1 via the bench_serving
+    # schema test's shared-prefix record assertions
     """N requests sharing a system prompt: the trie must cut prefill work
     and fresh pages, asserted against the no-reuse arithmetic via the
     ServingMetrics counters — tokens byte-identical to one-shot. (The

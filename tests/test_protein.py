@@ -127,7 +127,9 @@ def test_msa_row_mask_hides_residues():
     )
 
 
+@pytest.mark.slow  # 22.1s baseline (PR 12 tier-1 budget audit):
 def test_dap_sharded_matches_unsharded(eight_devices):
+    # mesh-matrix parity variant; single-device folding math stays tier-1
     """The whole iteration under a cp=4 mesh with DAP rules must reproduce
     the single-device result — GSPMD's axis-swap all_to_alls are exact."""
     msa, pair, mm, pm = _inputs()

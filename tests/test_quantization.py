@@ -49,7 +49,9 @@ def _tiny_qat_cfg(tmp_path, enable=True, dp=4, mp=2, nranks=8):
     return cfg
 
 
+@pytest.mark.slow  # 12.8s baseline (PR 12 tier-1 budget audit): the QAT
 def test_qat_trains_with_falling_loss(tmp_path, eight_devices):
+    # fake-quant math units stay tier-1; this is the e2e training variant
     from fleetx_tpu.core.engine import Trainer
     from fleetx_tpu.models import build_module
     import fleetx_tpu.parallel.env as dist_env

@@ -149,7 +149,12 @@ def test_opt_state_shardings_match_param_shardings_by_path(tmp_path, eight_devic
     }
     # every >=1-D moment leaf whose path suffix names a param must carry that
     # param's sharding (two same-shaped params with different shardings would
-    # collide under the old (shape, dtype) matching)
+    # collide under the old (shape, dtype) matching) — plus, since PR 12,
+    # the ZeRO update-shard axes folded on top when FLEETX_ZERO_UPDATE is
+    # live (the moment's spec still derives from ITS param's, which is
+    # what this regression test pins)
+    from fleetx_tpu.parallel.sharding import zero_update_spec
+
     checked = 0
     for path, leaf in jax.tree_util.tree_flatten_with_path(
         trainer.state.opt_state
@@ -160,7 +165,10 @@ def test_opt_state_shardings_match_param_shardings_by_path(tmp_path, eight_devic
         for start in range(len(keys)):
             hit = spec_by_path.get(keys[start:])
             if hit is not None and hit[0] == leaf.shape:
-                assert leaf.sharding.spec == hit[1], (keys, leaf.sharding.spec, hit)
+                want = hit[1]
+                if trainer._zero_update:
+                    want = zero_update_spec(want, leaf.shape, trainer.mesh)
+                assert leaf.sharding.spec == want, (keys, leaf.sharding.spec, want)
                 checked += 1
                 break
     assert checked >= 10  # moments for embeddings + qkv + mlp kernels etc.

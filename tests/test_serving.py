@@ -158,7 +158,10 @@ def test_flash_decode_per_slot_windows(model_and_params, monkeypatch):
 
 # ------------------------------------------------ per-request decode knobs
 
+@pytest.mark.slow  # 10.2s baseline (PR 12 tier-1 budget audit): per-request
 def test_per_request_rng_streams(model_and_params):
+    # rng stream reconstruction stays tier-1 via test_serving_recovery's
+    # test_sampling_replay_reconstructs_rng_stream
     """Identical sampling submissions draw from independent streams; an
     explicit seed pins a reproducible one; top_k=1 collapses to greedy."""
     model, params = model_and_params
@@ -177,7 +180,9 @@ def test_per_request_rng_streams(model_and_params):
         res[e].tokens, _one_shot_tokens(model, params, p, 8))
 
 
+@pytest.mark.slow  # 8.8s baseline (PR 12 tier-1 budget audit): per-request
 def test_min_length_suppresses_eos_per_request(model_and_params):
+    # override plumbing stays tier-1 via the other override/EOS gates
     """min_length counts decoded tokens per request: with min_length=3 the
     EOS greedy would emit at step 1 is banned until step 4."""
     model, params = model_and_params

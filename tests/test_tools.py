@@ -172,6 +172,72 @@ def test_bench_serving_records_schema(monkeypatch):
     assert all(s["tokens_per_s"] > 0 for s in d["sweep"])
 
 
+def test_pp_bubble_records_schema(monkeypatch, tmp_path):
+    """tools/bench_pp_bubble.py banks machine-readable records (ISSUE 12
+    satellite): predicted vs measured bubble per config, a streamed-vs-
+    sequential summary in --virtual-pp mode, and a JSON payload at
+    --out. Timing is stubbed here (deterministic, fast); the live
+    streamed<sequential gate is the slow-tier test below."""
+    sys.path.insert(0, REPO)
+    from tools import bench_pp_bubble as bpp
+
+    # plain stack fastest, streamed in between, sequential slowest ->
+    # measured bubbles 0.5 vs 0.75, streamed wins, gate passes
+    def fake_time(model, params, batch, mesh, repeats):
+        if mesh is None:
+            return 0.5
+        return 1.0 if getattr(model.cfg, "virtual_pp_stream") else 2.0
+
+    monkeypatch.setattr(bpp, "_time_grad", fake_time)
+    out = tmp_path / "pp_bubble.json"
+    recs = bpp.main(["--virtual-pp", "--tiny", "--gate",
+                     "--out", str(out)])
+    payload = json.loads(out.read_text())
+    assert [r["schedule"] for r in payload["records"]] == [
+        "streamed", "sequential"]
+    for rec in payload["records"]:
+        for key in ("pp", "virtual_pp", "num_microbatches", "step_s",
+                    "plain_stack_s", "model_bubble_fraction",
+                    "measured_bubble_fraction"):
+            assert key in rec, key
+        assert 0 <= rec["model_bubble_fraction"] < 1
+        assert 0 <= rec["measured_bubble_fraction"] < 1
+    summary = payload["virtual_pp_summary"]
+    assert summary["metric"] == "pp_bubble_virtual_pp"
+    assert summary["streamed_wins"] == summary["configs"] == 1
+    comp = summary["comparisons"][0]
+    assert comp["streamed_bubble"] == 0.5
+    assert comp["sequential_bubble"] == 0.75
+    # the predicted drain-tick fractions documented per schedule
+    assert bpp.predicted_bubble(2, 1, 4, "plain") == pytest.approx(1 / 5)
+    assert bpp.predicted_bubble(2, 2, 4, "streamed") == pytest.approx(3 / 7)
+    assert bpp.predicted_bubble(2, 2, 4, "sequential") == pytest.approx(1 / 5)
+
+    # non-virtual mode banks the plain-schedule sweep with the same keys
+    recs = bpp.main(["--tiny", "--out", str(out)])
+    payload = json.loads(out.read_text())
+    assert all(r["schedule"] == "plain" for r in payload["records"])
+    assert "virtual_pp_summary" not in payload
+
+
+@pytest.mark.slow  # three live jit-grad timings (~60s); the tier-1
+def test_pp_bubble_virtual_pp_gate_live(tmp_path):
+    # schema contract is test_pp_bubble_records_schema above
+    """The streamed virtual-chunk schedule must measure a strictly
+    smaller bubble than the sequential-chunk baseline at equal
+    (pp, v, M) — the ISSUE 12 regression gate, live (--gate raises
+    SystemExit when the streamed schedule loses)."""
+    sys.path.insert(0, REPO)
+    from tools import bench_pp_bubble as bpp
+
+    out = tmp_path / "pp_bubble.json"
+    bpp.main(["--virtual-pp", "--gate", "--pp", "2", "--out", str(out)])
+    payload = json.loads(out.read_text())
+    comp = payload["virtual_pp_summary"]["comparisons"][0]
+    assert comp["streamed_wins"]
+    assert comp["streamed_step_s"] < comp["sequential_step_s"]
+
+
 @pytest.mark.slow  # 9.8s on the slow-host baseline (PR 7 tier-1 budget audit)
 def test_chaos_check_sentry_scenario(tmp_path):
     """The chaos smoke driver's sentry scenario passes in-process (the
@@ -183,6 +249,20 @@ def test_chaos_check_sentry_scenario(tmp_path):
     assert rc == 0
 
 
+@pytest.mark.slow  # ~18s; the contract itself is tier-1 via
+def test_chaos_check_sentry_zero_scenario(tmp_path):
+    # tests/test_zero_update.py (sentry-skip byte parity on the sharded
+    # step); this proves the CLI scenario end-to-end
+    """The ZeRO-sharded sentry chaos scenario (NaN skip leaves sharded
+    params + opt state byte-identical, FLEETX_ZERO_UPDATE=1 on a dp
+    mesh) passes through the CLI driver."""
+    sys.path.insert(0, REPO)
+    import tools.chaos_check as cc
+
+    rc = cc.main(["--only", "sentry_zero", "--workdir", str(tmp_path)])
+    assert rc == 0
+
+
 def test_chaos_check_unknown_scenario_fails(tmp_path):
     """An unknown scenario name is a non-zero exit, not a silent pass."""
     sys.path.insert(0, REPO)
@@ -191,7 +271,10 @@ def test_chaos_check_unknown_scenario_fails(tmp_path):
     assert cc.main(["--only", "nope", "--workdir", str(tmp_path)]) == 1
 
 
+@pytest.mark.slow  # 75.2s baseline (PR 12 tier-1 budget audit): every
 def test_chaos_check_serving_recovery_scenarios(tmp_path, capsys):
+    # contract here is tier-1 via tests/test_serving_recovery.py; this
+    # proves the CLI driver end-to-end (same precedent as the spill smoke)
     """The serving crash-safety scenarios (recovery, poison quarantine,
     hung-tick watchdog, graceful drain) pass through the CLI driver and
     print one PASS line each — the acceptance-gate demonstration outside

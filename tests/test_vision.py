@@ -148,7 +148,10 @@ def test_cls_module_end_to_end(tmp_path, eight_devices):
     assert int(trainer.state.step) == 4
 
 
+@pytest.mark.slow  # 21.9s baseline (PR 12 tier-1 budget audit): the
 def test_vit_flash_matches_xla(monkeypatch):
+    # flash-vs-dense parity gate stays tier-1 on the GPT suites
+    # (test_flash_attention / test_decode_attention)
     """Flash-routed ViT encoder (seq 17 pads to a single kernel tile) must
     match the XLA attention path."""
     imgs = jnp.asarray(np.random.default_rng(0).random((2, 32, 32, 3)),

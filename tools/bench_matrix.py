@@ -28,6 +28,20 @@ names the winning page size and block_k; ``--out`` banks the whole
 grid.
 
     BENCH_MATRIX_PLATFORM=tpu python tools/bench_matrix.py --serving-tuning
+
+Train-tuning mode (``--train-tuning``, ROADMAP item 3c's remaining fold:
+the r05 staged remat/block-size sweep, promoted into the banked grid):
+drives ``bench.py`` through (a) remat-policy cases (``--remat-cases`` —
+granularities, ``none``, and ``granularity+save+save`` extra-save
+points) and (b) a training flash-attention block sweep
+(``--flash-blocks``, FLEETX_FLASH_BLOCK_QxK), one subprocess per case
+with extras off. Every case sees the same data and seed, so final
+losses must agree (remat and kernel tiling change scheduling, never
+math) — divergence fails the grid. The summary names best_remat and
+best_flash_block, so the first TPU window auto-banks a tuned training
+config next to the serving one.
+
+    BENCH_MATRIX_PLATFORM=tpu python tools/bench_matrix.py --train-tuning
 """
 
 from __future__ import annotations
@@ -254,6 +268,132 @@ def run_serving_tuning(args):
     return results
 
 
+def _run_bench_train(env_extra, timeout):
+    """One ``bench.py`` subprocess (extras off); returns the anchor
+    training record (None on failure, with the log tail)."""
+    cmd = [sys.executable, os.path.join(REPO, "bench.py")]
+    env = dict(os.environ)
+    env["FLEETX_LOG_LEVEL"] = "ERROR"
+    env["BENCH_EXTRA"] = "0"  # one training record per case
+    if os.environ.get("BENCH_MATRIX_PLATFORM", "cpu") == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_PLATFORM"] = "cpu"
+        # host-feasible per-case work; a TPU run keeps bench.py defaults
+        env.setdefault("BENCH_SEQ", "128")
+        env.setdefault("BENCH_BATCH", "1")
+        env.setdefault("BENCH_STEPS", "2")
+        env.setdefault("BENCH_WARMUP", "1")
+    env.update(env_extra)
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, f"[bench_matrix] bench.py timed out after {timeout}s"
+    if proc.returncode != 0:
+        return None, (proc.stdout + proc.stderr)[-2000:]
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("metric", "").startswith("gpt_345m_pretrain"):
+                return rec, None
+    return None, "[bench_matrix] no training record in bench.py stdout"
+
+
+def _train_case(name, rec, err, extra=None):
+    """Normalize one train-tuning case record. A non-finite loss is a
+    FAILED case even when the subprocess exits 0 — NaN would otherwise
+    sail through the convergence gate (NaN comparisons are all False)."""
+    loss = (rec or {}).get("detail", {}).get("loss")
+    out = {"case": name,
+           "ok": bool(err is None and rec is not None
+                      and loss is not None and np.isfinite(loss))}
+    if extra:
+        out.update(extra)
+    if rec is not None:
+        d = rec["detail"]
+        out.update({
+            "tokens_per_s": rec["value"],
+            "mfu": d.get("mfu"),
+            "xla_mfu": d.get("xla_mfu"),
+            "loss": d.get("loss"),
+            "step_time_s": d.get("step_time_s"),
+            "peak_hbm_gb": d.get("peak_hbm_gb"),
+            "overlap": d.get("overlap"),
+        })
+    if err is not None:
+        out["log_tail"] = err
+    return out
+
+
+def run_train_tuning(args):
+    """ROADMAP 3c's remaining fold (the r05 staged remat/block sweep,
+    promoted): remat policy x flash-block cases as parity-gated bench.py
+    subprocess runs. Remat sweeps at the default blocks, blocks sweep at
+    the default (core_attn) remat — the cross terms are second-order and
+    a tuning window pays per case. The winners summary is what the first
+    TPU window banks as the tuned training config; the convergence gate
+    (same data+seed, remat/tiling must not change the math) fails any
+    case whose loss diverges."""
+    results = []
+    for g in (s.strip() for s in args.remat_cases.split(",") if s.strip()):
+        env = {"BENCH_RECOMPUTE": "0"} if g == "none" else {
+            "BENCH_RECOMPUTE": "1", "BENCH_GRANULARITY": g.split("+")[0]}
+        if "+" in g:  # e.g. core_attn+qkv_out+ffn_gelu -> extra saves
+            env["BENCH_EXTRA_SAVES"] = ",".join(g.split("+")[1:])
+        rec, err = _run_bench_train(env, args.timeout)
+        results.append(_train_case(f"Remat[{g}]", rec, err,
+                                   {"remat": g}))
+    for bk in (s.strip() for s in args.flash_blocks.split(",") if s.strip()):
+        q, _, k = bk.partition("x")
+        env = {"FLEETX_FLASH_BLOCK_Q": q, "FLEETX_FLASH_BLOCK_K": k or q}
+        rec, err = _run_bench_train(env, args.timeout)
+        results.append(_train_case(f"FlashBlock[{bk}]", rec, err,
+                                   {"flash_block": bk}))
+    return results
+
+
+def _train_tuning_summary(results, loss_rtol):
+    import statistics
+
+    failures = [r["case"] for r in results if not r["ok"]]
+    ok = [r for r in results if r["ok"]]
+    losses = [r["loss"] for r in ok if r.get("loss") is not None]
+    diverged = []
+    if losses:
+        # reference = the MEDIAN loss, not the arbitrary first case: if
+        # the first case were the broken one, every correct case would be
+        # flagged and the broken one crowned best_* below
+        ref_loss = statistics.median(losses)
+        for r in ok:
+            if r.get("loss") is None:
+                continue
+            rel = abs(r["loss"] - ref_loss) / max(abs(ref_loss), 1e-9)
+            if rel > loss_rtol:
+                diverged.append((r["case"], round(rel, 4)))
+    # a diverged case is mathematically wrong, not fast — it must never
+    # be banked as the winner a TPU window would tune toward
+    bad = {name for name, _ in diverged}
+    clean = [r for r in ok if r["case"] not in bad]
+    remat = [r for r in clean if "remat" in r]
+    blocks = [r for r in clean if "flash_block" in r]
+    return {
+        "metric": "bench_matrix_train_tuning",
+        "cases": len(results),
+        "passed": len(ok),
+        "failed_cases": failures,
+        "loss_diverged": diverged,
+        "best_remat": (max(remat, key=lambda r: r["tokens_per_s"])["remat"]
+                       if remat else None),
+        "best_flash_block": (
+            max(blocks, key=lambda r: r["tokens_per_s"])["flash_block"]
+            if blocks else None),
+    }
+
+
 def _serving_tuning_summary(results):
     failures = [r["case"] for r in results if not r["ok"]]
     block_cases = [r for r in results
@@ -292,7 +432,34 @@ def main(argv=None):
     ap.add_argument("--block-k", default="128,256,512",
                     help="FLEETX_DECODE_BLOCK_K values for the int8 "
                          "flash-decode retune (empty = skip)")
+    ap.add_argument("--train-tuning", action="store_true",
+                    help="run the training tuning grid (remat policy + "
+                         "flash block sizes as parity-gated bench.py "
+                         "runs) instead of the topology grid")
+    ap.add_argument("--remat-cases", default="core_attn,full_attn,full,"
+                                             "core_attn+qkv_out+ffn_gelu",
+                    help="remat cases: granularity, 'none', or "
+                         "granularity+save+save (empty = skip)")
+    ap.add_argument("--flash-blocks", default="256x256,512x512,1024x512",
+                    help="FLEETX_FLASH_BLOCK_QxK values to sweep "
+                         "(empty = skip)")
     args = ap.parse_args(argv)
+
+    if args.train_tuning:
+        results = run_train_tuning(args)
+        for rec in results:
+            print(json.dumps(rec))
+        summary = _train_tuning_summary(results, args.loss_rtol)
+        print(json.dumps(summary))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"summary": summary, "results": results}, f,
+                          indent=2)
+        if summary["failed_cases"] or summary["loss_diverged"]:
+            raise SystemExit(
+                f"train tuning failed: "
+                f"{summary['failed_cases'] or summary['loss_diverged']}")
+        return
 
     if args.serving_tuning:
         results = run_serving_tuning(args)

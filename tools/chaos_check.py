@@ -5,6 +5,10 @@ per scenario, non-zero exit on any failure:
 
 - ``sentry``: a NaN-poisoned batch is skipped and the final params are
   byte-identical to a run that never saw it;
+- ``sentry_zero``: the same contract on the ZeRO-sharded step
+  (FLEETX_ZERO_UPDATE=1, dp mesh): the skip's rollback select runs on
+  the 1/N update shards and params AND dp-sharded opt state stay
+  byte-identical to the clean stream;
 - ``ckpt``: the newest checkpoint is corrupted on disk, restore falls
   back to the prior step and quarantines the bad one;
 - ``serving``: a bounded queue rejects, a queue-TTL expires to
@@ -82,8 +86,9 @@ _TRAIN_YAML = textwrap.dedent(
 )
 
 
-def _cfg(tmp, name, **over):
-    """Tiny single-device trainer config rooted at ``tmp/name``."""
+def _cfg(tmp, name, nranks=1, **over):
+    """Tiny trainer config rooted at ``tmp/name`` (nranks>1 derives a
+    dp mesh over the first nranks devices)."""
     from fleetx_tpu.utils.config import get_config
 
     os.makedirs(tmp, exist_ok=True)
@@ -91,7 +96,7 @@ def _cfg(tmp, name, **over):
     if not os.path.exists(path):
         with open(path, "w") as f:
             f.write(_TRAIN_YAML)
-    cfg = get_config(path, nranks=1)
+    cfg = get_config(path, nranks=nranks)
     for k, v in over.items():
         node = cfg
         *parents, leaf = k.split(".")
@@ -167,6 +172,63 @@ def scenario_sentry(tmp):
     skips = ev.find("sentry_skip")
     assert len(skips) == 1 and skips[0].attrs["step"] == 1, skips
     return "1 NaN step skipped, params byte-identical, sentry_skip banked"
+
+
+def scenario_sentry_zero(tmp):
+    """The PR 3 sentry parity contract on the ZeRO-SHARDED step (ISSUE
+    12): under FLEETX_ZERO_UPDATE=1 on a dp mesh, a NaN-batch skip must
+    leave sharded params AND opt state byte-identical to a run that
+    never saw the batch — the in-jit rollback select operates on the
+    1/N update shards, and the param all-gather must reproduce the
+    exact prior bytes."""
+    import jax
+    import numpy as np
+
+    from fleetx_tpu.resilience.faults import faults
+
+    if jax.device_count() < 2:
+        return ("skipped: needs >=2 devices for a dp mesh (run with "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    from fleetx_tpu.core.engine import _unbox
+
+    over = {"Engine.max_steps": 3}
+    prev = os.environ.get("FLEETX_ZERO_UPDATE")
+    os.environ["FLEETX_ZERO_UPDATE"] = "1"
+    try:
+        data = _batches(_cfg(tmp, "probe", nranks=2, **over), 4)
+        clean = _fit(_cfg(tmp, "clean", nranks=2, **over),
+                     [data[0], data[2], data[3]])
+        faults.configure(nan_batch="1")
+        try:
+            faulty = _fit(_cfg(tmp, "faulty", nranks=2, **over), data)
+        finally:
+            faults.reset()
+    finally:
+        if prev is None:
+            os.environ.pop("FLEETX_ZERO_UPDATE", None)
+        else:
+            os.environ["FLEETX_ZERO_UPDATE"] = prev
+    assert clean._zero_update and faulty._zero_update, \
+        "ZeRO update sharding was not active; the scenario tested nothing"
+    assert faulty.sentry_skips == 1, faulty.sentry_skips
+    assert int(faulty.state.step) == int(clean.state.step) == 3
+    for a, b in zip(_params(clean), _params(faulty)):
+        assert np.array_equal(a, b), "sharded params diverged after skip"
+    for a, b in zip(
+        jax.tree.leaves(jax.tree.map(np.asarray, clean.state.opt_state)),
+        jax.tree.leaves(jax.tree.map(np.asarray, faulty.state.opt_state)),
+    ):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            "sharded opt state diverged after skip"
+    shards = {
+        str(leaf.sharding.spec)
+        for leaf in jax.tree.leaves(_unbox(faulty.state.opt_state))
+        if hasattr(leaf, "sharding") and getattr(leaf, "ndim", 0) > 0
+    }
+    assert any("dp" in s for s in shards), (
+        f"opt state is not dp-sharded under FLEETX_ZERO_UPDATE=1: {shards}")
+    return ("NaN step skipped on the ZeRO-sharded step: params + "
+            "dp-sharded opt state byte-identical to the clean stream")
 
 
 def scenario_ckpt(tmp):
@@ -519,6 +581,7 @@ def scenario_serving_spill(tmp):
 
 SCENARIOS = {
     "sentry": scenario_sentry,
+    "sentry_zero": scenario_sentry_zero,
     "ckpt": scenario_ckpt,
     "serving": scenario_serving,
     "serving_recovery": scenario_serving_recovery,

@@ -9,6 +9,12 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
+# comms/compute overlap flags must be in XLA_FLAGS before anything touches
+# a jax backend (env-gated, TPU-only by default — utils/xla_flags.py)
+from fleetx_tpu.utils.xla_flags import apply_overlap_flags
+
+apply_overlap_flags()
+
 from fleetx_tpu.core.engine import Trainer
 from fleetx_tpu.data import build_dataloader
 from fleetx_tpu.models import build_module
