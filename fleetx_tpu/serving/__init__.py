@@ -42,6 +42,11 @@ from fleetx_tpu.serving.engine import (
 )
 from fleetx_tpu.serving.metrics import ServingMetrics
 from fleetx_tpu.serving.scheduler import FIFOScheduler, Request
+from fleetx_tpu.serving.spec import (
+    DraftModelProposer,
+    NgramProposer,
+    Proposer,
+)
 
 __all__ = [
     "QueueFull",
@@ -56,6 +61,9 @@ __all__ = [
     "SlotKVCacheManager",
     "FIFOScheduler",
     "Request",
+    "DraftModelProposer",
+    "NgramProposer",
+    "Proposer",
     "ServingMetrics",
     "sample_tokens",
     "scatter_slot",

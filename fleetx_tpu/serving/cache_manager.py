@@ -582,6 +582,48 @@ class PagePool:
         self.version += 1
         return True
 
+    def ensure_span(self, lane: int, pos: int, n: int) -> int:
+        """Make as many of logical positions ``[pos, pos + n)`` writable
+        for ``lane`` as the pool can supply, allocating pages in order
+        (the speculative-decoding verify write: one slot for the pending
+        token plus up to k draft tokens). Returns the count of LEADING
+        covered positions — the engine clamps the lane's draft length to
+        ``covered - 1`` so no accepted token's K/V can ever land on the
+        trash page, while the un-covered tail's writes route there
+        harmlessly (rejected-draft territory by construction)."""
+        covered = 0
+        for i in range(n):
+            if not self.ensure_page(lane, pos + i):
+                break
+            covered += 1
+        return covered
+
+    def trim_lane(self, lane: int, live_tokens: int) -> int:
+        """Release ``lane``'s pages beyond those covering its
+        ``live_tokens`` valid positions — the speculative tick's
+        post-verify cleanup, returning rejected-draft pages to the pool
+        the same tick so a lane's transient draft window can never
+        starve a NEIGHBOR'S next pending-token allocation (the plain
+        engine would not have held those pages, and byte parity demands
+        identical ``cache_full`` decisions). Only unshared
+        (refcount-1, trie-unregistered) tail pages are eligible — draft
+        pages always are, prompt/prefix pages always sit inside the
+        live span. Returns the number of pages released."""
+        need = (max(int(live_tokens), 1) - 1) // self.page_size + 1
+        freed = 0
+        for i in range(int(self.alloc_counts[lane]) - 1, need - 1, -1):
+            page = int(self.tables[lane, i])
+            if self.ref[page] != 1 or page in self._node_of_page:
+                break  # shared/registered page past the live span:
+            self.ref[page] = 0  # structurally impossible — stop cold
+            self._free.append(page)
+            self.tables[lane, i] = 0
+            self.alloc_counts[lane] = i
+            freed += 1
+        if freed:
+            self.version += 1
+        return freed
+
     def check_invariants(self) -> None:
         """Assert the pool's conservation/refcount invariants; raises
         AssertionError with a specific message on any breach. The chaos
@@ -852,6 +894,18 @@ class PagedKVCacheManager(_LaneBook):
         (``lengths[slot]``); False = pool dry, caller retires the
         request."""
         return self.pool.ensure_page(slot, int(self.lengths[slot]))
+
+    def ensure_span(self, slot: int, n: int) -> int:
+        """Grow ``slot``'s chain toward covering its next ``n`` write
+        positions (the speculative verify window: pending token + k
+        drafts); returns how many leading positions are covered — see
+        :meth:`PagePool.ensure_span` for the draft-clamp contract."""
+        return self.pool.ensure_span(slot, int(self.lengths[slot]), n)
+
+    def trim_span(self, slot: int) -> int:
+        """Release ``slot``'s pages past its live prefix (rejected-draft
+        territory) back to the pool — see :meth:`PagePool.trim_lane`."""
+        return self.pool.trim_lane(slot, int(self.lengths[slot]))
 
     def free(self, slot: int) -> None:
         """Release the lane and its page chain. No buffer zeroing — the

@@ -86,6 +86,32 @@ safety is precision-agnostic. Both knobs default off ("bf16" = the model
 compute dtype), and the default path stays byte-identical; quantized
 configs trade byte parity for a documented token/logit tolerance.
 
+Speculative decoding (``FLEETX_SERVING_SPEC=1``, default off;
+docs/SERVING.md "Speculative decoding"): each tick a proposer
+(serving/spec.py — n-gram prompt lookup by default, optionally a small
+draft model) guesses up to ``FLEETX_SERVING_SPEC_K`` tokens per active
+request, the drafts are written append-only into the request's pages,
+and ONE batched prefill-shaped verification call — the same multi-token
+``cache_positions`` seam replay/chunked prefill already write through —
+scores all k+1 positions at once. Greedy acceptance keeps the longest
+draft prefix matching the target argmax plus the correction token, so
+greedy streams are BYTE-IDENTICAL to the non-speculative engine by
+construction; sampling acceptance runs standard distribution-preserving
+speculative rejection (accept d with prob p(d) for the deterministic
+proposers, resample the rejection residual otherwise), consuming exactly
+one rng split per EMITTED token so replay recovery's stream
+reconstruction is unchanged. Rejected tails cost nothing: rollback is a
+host-side pointer move (the per-row live length simply doesn't advance
+past the accepted prefix — the no-zeroing live-window contract already
+leaves stale K/V beyond the window unattended), and the engine clamps
+each request's draft length to min(remaining token budget, page/lane
+capacity) BEFORE proposing, so a k-token draft can never overrun
+``max_length`` or its storage mid-verify. A verify-call fault rides the
+same transactional-tick rollback + replay recovery as a plain decode
+fault (per-request draft counters are snapshot-covered), and the
+proposer's lane state resets with recovery and rebuilds lazily from
+host truth.
+
 Unsupported request shapes (beam search, repetition penalty, forced
 EOS/BOS) raise at construction/submit — they need cross-step state the
 slot loop does not carry; use the one-shot ``generate()`` for those.
@@ -167,6 +193,7 @@ from fleetx_tpu.serving.cache_manager import (
 from fleetx_tpu.resilience.faults import faults
 from fleetx_tpu.serving.metrics import ServingMetrics
 from fleetx_tpu.serving.scheduler import FIFOScheduler, Request
+from fleetx_tpu.serving.spec import build_proposer
 from fleetx_tpu.utils.log import logger
 
 __all__ = [
@@ -176,6 +203,7 @@ __all__ = [
     "ServingResult",
     "ShuttingDown",
     "TickTimeout",
+    "filter_logits",
     "sample_tokens",
 ]
 
@@ -227,31 +255,39 @@ def _deactivate(st, slot):
     return {**st, "active": st["active"].at[slot].set(False)}
 
 
-def sample_tokens(logits, keys, greedy, temperature, top_k, top_p, *,
-                  topk_cap: int):
-    """Vectorized per-row sampler: each batch row applies ITS OWN decode
-    strategy (greedy flag, temperature, top-k, top-p) and draws from its
-    own rng key — the per-request-overrides core of the serving engine.
-
-    ``top_k`` must be pre-normalized to ``[0, topk_cap]`` (0 = no filter;
-    the engine clamps larger requests at submit): one static
-    ``lax.top_k(topk_cap)`` partial sort serves every row, the per-row
-    cutoff is the row's k-th entry of it. Top-p reuses the sort-free
-    threshold bisection from ``generation.py`` with per-row targets;
-    greedy rows take the argmax of the unfiltered logits (exactly
-    ``_sample``'s greedy branch, so greedy parity with ``generate()``
-    holds per row)."""
-    greedy_tok = jnp.argmax(logits, axis=-1)
+def filter_logits(logits, temperature, top_k, top_p, *, topk_cap: int):
+    """THE per-row sampling filter pipeline — temperature scale, top-k
+    via ONE static ``lax.top_k(topk_cap)`` partial sort (the per-row
+    cutoff is the row's k-th entry; ``top_k`` pre-normalized to
+    ``[0, topk_cap]``, 0 = no filter), then the sort-free top-p
+    threshold bisection from ``generation.py`` with per-row targets.
+    ``logits`` [n, vocab] with per-row knobs [n] → filtered logits
+    (removed entries at ``_NEG``). Shared by :func:`sample_tokens` and
+    the speculative ``_verify_fn`` so the two sampling paths can never
+    drift apart."""
     vocab = logits.shape[-1]
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
     cap = max(1, min(topk_cap, vocab))
-    vals = jax.lax.top_k(scaled, cap)[0]  # [b, cap] descending
+    vals = jax.lax.top_k(scaled, cap)[0]  # [n, cap] descending
     kth = jnp.take_along_axis(
         vals, jnp.clip(top_k - 1, 0, cap - 1)[:, None], axis=-1
     )
     filtered = jnp.where((top_k > 0)[:, None] & (scaled < kth), _NEG, scaled)
     probs, thresh = _top_p_cutoff_bisect(filtered, top_p[:, None])
-    filtered = jnp.where(probs >= thresh, filtered, _NEG)
+    return jnp.where(probs >= thresh, filtered, _NEG)
+
+
+def sample_tokens(logits, keys, greedy, temperature, top_k, top_p, *,
+                  topk_cap: int):
+    """Vectorized per-row sampler: each batch row applies ITS OWN decode
+    strategy (greedy flag, temperature, top-k, top-p) and draws from its
+    own rng key — the per-request-overrides core of the serving engine.
+    Filtering is :func:`filter_logits`; greedy rows take the argmax of
+    the unfiltered logits (exactly ``_sample``'s greedy branch, so
+    greedy parity with ``generate()`` holds per row)."""
+    greedy_tok = jnp.argmax(logits, axis=-1)
+    filtered = filter_logits(logits, temperature, top_k, top_p,
+                             topk_cap=topk_cap)
     sampled = jax.vmap(jax.random.categorical)(keys, filtered)
     return jnp.where(greedy, greedy_tok, sampled).astype(jnp.int32)
 
@@ -300,7 +336,10 @@ class ServingEngine:
                  kv_dtype: Optional[str] = None,
                  weight_dtype: Optional[str] = None,
                  prefill_chunk: Optional[int] = None,
-                 host_cache_bytes: Optional[int] = None):
+                 host_cache_bytes: Optional[int] = None,
+                 spec: Optional[bool] = None,
+                 spec_k: Optional[int] = None,
+                 spec_proposer=None):
         gen_cfg = gen_cfg or GenerationConfig(decode_strategy="greedy")
         if gen_cfg.repetition_penalty != 1.0:
             raise ValueError("continuous batching does not support "
@@ -466,6 +505,39 @@ class ServingEngine:
             scatter_slot, donate_argnums=(0, 1) if donate else ())
         self._prefill_jits = {}  # (kind, bucket_len) -> jitted prefill
         self._donate_cache = donate
+        # speculative decoding (module docstring): default OFF — a spec-
+        # disabled engine never touches the proposer/verify machinery and
+        # stays byte-identical to the pre-spec engine. An explicit
+        # spec_proposer IMPLIES speculation (the kwarg wins over the
+        # env); handing one to an explicitly spec=False engine is a
+        # config contradiction, not something to ignore silently.
+        self.spec = (spec if spec is not None
+                     else True if spec_proposer is not None
+                     else _env_int("FLEETX_SERVING_SPEC", 0) == 1)
+        if spec_proposer is not None and not self.spec:
+            raise ValueError(
+                "spec_proposer was given but speculation is explicitly "
+                "disabled (spec=False); drop one or the other")
+        self.spec_k = (spec_k if spec_k is not None
+                       else _env_int("FLEETX_SERVING_SPEC_K", 4))
+        self._proposer = None
+        if self.spec:
+            if self.spec_k < 1:
+                raise ValueError(
+                    f"spec_k must be >= 1 when speculation is on, got "
+                    f"{self.spec_k} (FLEETX_SERVING_SPEC_K)")
+            self._proposer = spec_proposer or build_proposer(
+                os.environ.get("FLEETX_SERVING_SPEC_DRAFT", ""),
+                self.model, {"params": self.params},
+                prefill_bucket=self.prefill_bucket)
+            self._proposer.bind(self.slots, self.cache_len)
+            # one compile per (k, all_greedy) actually seen: k only drops
+            # below spec_k when a lane nears cache capacity
+            self._verify_jit = jax.jit(
+                self._verify_fn, static_argnums=(6, 7),
+                donate_argnums=(1, 2) if donate else ())
+            obs_emit("spec_enabled", k=self.spec_k,
+                     proposer=self._proposer.name)
         # observability (docs/OBSERVABILITY.md): one env var makes this
         # replica scrapeable, and /healthz turns 503 the instant
         # request_shutdown() flips _shutting_down — the rotate-me-out
@@ -680,7 +752,8 @@ class ServingEngine:
         decoded = len(self._active)
         retired = []
         if decoded:
-            retired = self._tick_decode()
+            retired = (self._tick_decode_spec() if self._proposer is not None
+                       else self._tick_decode())
         # fresh clock: prefill/decode above may have eaten the deadline
         timed_out += self._expire_active(self._now())
         return {"admitted": admitted, "decoded": decoded, "chunked": chunked,
@@ -757,9 +830,12 @@ class ServingEngine:
             # mid-chunk fault rolls the request back to its exact
             # pre-tick chunk position (req.chunk_cache is device state —
             # NOT captured; recovery requeues mid-prefill requests and
-            # rebuilds it from scratch)
+            # rebuilds it from scratch); spec_proposed/accepted cover the
+            # speculative draft counters a mid-verify fault would have
+            # advanced
             "reqs": [(r, r.slot, r.admit_time, r.first_token_time,
-                      len(r.tokens), r.prefill_pos, r.phase) for r in reqs],
+                      len(r.tokens), r.prefill_pos, r.phase,
+                      r.spec_proposed, r.spec_accepted) for r in reqs],
         }
 
     def _restore(self, snap) -> None:
@@ -767,12 +843,15 @@ class ServingEngine:
         self._active = snap["active"]
         self._prefilling = snap["prefilling"]
         self._results = snap["results"]
-        for r, slot, admit_t, first_t, ntok, ppos, phase in snap["reqs"]:
+        for (r, slot, admit_t, first_t, ntok, ppos, phase, sprop,
+             sacc) in snap["reqs"]:
             r.slot = slot
             r.admit_time = admit_t
             r.first_token_time = first_t
             r.prefill_pos = ppos
             r.phase = phase
+            r.spec_proposed = sprop
+            r.spec_accepted = sacc
             del r.tokens[ntok:]
 
     def _handle_tick_fault(self, snap, exc: Exception) -> Dict:
@@ -875,6 +954,12 @@ class ServingEngine:
             else:
                 self.cache_manager = SlotKVCacheManager(
                     self.model, self.slots, self.cache_len)
+            if self._proposer is not None:
+                # draft-lane state is device-adjacent: drop it and let
+                # the next propose() rebuild lazily from host truth
+                # (deterministic, so post-recovery drafts — and the
+                # verified streams — stay byte-identical)
+                self._proposer.reset()
             retired = []
             for _, req in old_active:
                 req.slot = None
@@ -1807,6 +1892,307 @@ class ServingEngine:
                 retired.append(req.id)
         return retired
 
+    # ------------------------------------------------ speculative decoding
+
+    def _verify_fn(self, params, cache, st, tables, draft, draft_len,
+                   k: int, all_greedy: bool):
+        """Jitted draft-k-verify-once step (module docstring): ONE
+        prefill-shaped forward scores all ``k+1`` positions of every
+        lane — ``[last_tok, d1..dk]`` written at the lane's own
+        ``cache_positions`` offsets, exactly the multi-token seam
+        chunked prefill/replay use — then acceptance runs ON DEVICE so
+        the host round-trip stays O(slots·k), not O(vocab).
+
+        Greedy rows keep the longest draft prefix matching the per-
+        position argmax (with the per-position ``min_new`` EOS
+        suppression the sequential loop would have applied) plus the
+        correction/bonus token — byte-identical to k+1 plain ticks by
+        construction. Sampling rows run speculative rejection per
+        position (accept ``d`` with prob ``p(d)`` — the proposers are
+        deterministic, q = 1 — else sample the residual ``(p - q)+``),
+        consuming exactly one rng split per EMITTED token so replay's
+        stream reconstruction is unchanged. Inactive lanes ride along
+        with writes pinned beyond every live window (paged: position
+        clamps re-route through zeroed tables to the trash page; slot:
+        the tail rows of a dead/mid-prefill lane, which the next
+        tenant's full-row scatter overwrites). Returns
+        ``(cache, new_state, out_tokens [b,k+1], n_emit [b],
+        n_accepted [b], done [b])``."""
+        params = self._dequant_params(params)
+        s = k + 1
+        active = st["active"]
+        lengths = st["lengths"]
+        max_pos = self.model.cfg.max_position_embeddings
+        # pinned write base for inactive rows: the paged path clamps all
+        # s positions onto the last logical slot (trash-routed when
+        # unallocated); the slot path needs start <= cache_len - s so the
+        # per-row dynamic_update_slice cannot clamp-shift backwards
+        pin = self.cache_len - 1 if self.paged else self.cache_len - s
+        wpos = jnp.where(active, lengths, pin)
+        ids = jnp.concatenate([st["last_tok"][:, None], draft], axis=1)
+        posid = jnp.minimum(wpos[:, None] + jnp.arange(s, dtype=jnp.int32),
+                            max_pos - 1)
+        posid = jnp.where(active[:, None], posid, 0)
+        logits, cache = decode_step(
+            self.model, params, cache, ids, posid, None,
+            cache_positions=wpos, block_tables=tables)
+        logits = logits.astype(jnp.float32)
+        vocab = logits.shape[-1]
+        # per-position min_new suppression: position j samples generated
+        # token number decoded + j + 1, so EOS is banned while
+        # decoded + j < min_new — the condition each sequential tick
+        # would have applied
+        decoded_at = st["decoded"][:, None] + jnp.arange(s)[None, :]
+        suppress = ((decoded_at < st["min_new"][:, None])[:, :, None]
+                    & (jnp.arange(vocab)[None, None, :]
+                       == st["eos"][:, None, None]))
+        logits = jnp.where(suppress, _NEG, logits)
+        greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [b, s]
+        idx = jnp.arange(s, dtype=jnp.int32)[None, :]
+        if all_greedy:
+            # vectorized acceptance: position j's target IS what tick j
+            # would have emitted, so the emitted run is target[:acc+1]
+            # cut at the first EOS inside it; no rng is consumed
+            match = ((draft == greedy_tok[:, :k])
+                     & (jnp.arange(k)[None, :] < draft_len[:, None]))
+            acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+            m0 = acc + 1
+            is_eos = greedy_tok == st["eos"][:, None]
+            eos_pos = jnp.min(
+                jnp.where(is_eos & (idx < m0[:, None]), idx, s), axis=1)
+            m = jnp.minimum(m0, eos_pos + 1)
+            acc = jnp.minimum(acc, m)
+            out = greedy_tok
+            new_rng = st["rng"]  # greedy consumes no randomness
+        else:
+            # per-position target distributions through THE shared
+            # per-row sampler filter pipeline (rows repeated per
+            # position: row b*s + j filters position j of lane b)
+            b = logits.shape[0]
+            filt = filter_logits(
+                logits.reshape(b * s, vocab),
+                jnp.repeat(st["temperature"], s),
+                jnp.repeat(st["top_k"], s),
+                jnp.repeat(st["top_p"], s),
+                topk_cap=self.topk_cap).reshape(b, s, vocab)
+            p = jax.nn.softmax(filt, axis=-1)
+            split2 = jax.vmap(functools.partial(jax.random.split, num=2))
+            alive = active
+            carry = st["rng"]
+            m = jnp.zeros_like(lengths)
+            acc = jnp.zeros_like(lengths)
+            cols = []
+            for j in range(s):
+                pair = split2(carry)
+                step_key, next_carry = pair[:, 0], pair[:, 1]
+                sub = split2(step_key)
+                d = (draft[:, j] if j < k
+                     else jnp.zeros_like(st["last_tok"]))
+                has_draft = j < draft_len
+                pj = p[:, j, :]
+                p_d = jnp.take_along_axis(pj, d[:, None], axis=1)[:, 0]
+                u = jax.vmap(jax.random.uniform)(sub[:, 0])
+                # residual (p - q)+ of a deterministic (one-hot) draft:
+                # p with the draft token zeroed; log turns zeros to -inf
+                resid = jnp.where(jnp.arange(vocab)[None, :] == d[:, None],
+                                  0.0, pj)
+                samp_rej = jax.vmap(jax.random.categorical)(
+                    sub[:, 1], jnp.log(resid))
+                samp_direct = jax.vmap(jax.random.categorical)(
+                    sub[:, 1], filt[:, j, :])
+                accept_s = has_draft & (u < p_d)
+                tok_s = jnp.where(accept_s, d,
+                                  jnp.where(has_draft, samp_rej,
+                                            samp_direct))
+                accept_g = has_draft & (d == greedy_tok[:, j])
+                accept_j = jnp.where(st["greedy"], accept_g, accept_s)
+                tok_j = jnp.where(st["greedy"], greedy_tok[:, j],
+                                  tok_s).astype(jnp.int32)
+                cols.append(jnp.where(alive, tok_j, 0))
+                m = m + alive
+                acc = acc + (alive & accept_j)
+                # one split per emitted token, every active row (the
+                # mixed-tick baseline advances greedy rows' streams too)
+                carry = jnp.where(alive[:, None], next_carry, carry)
+                alive = alive & accept_j & (tok_j != st["eos"])
+            out = jnp.stack(cols, axis=1)
+            new_rng = carry
+        m = jnp.where(active, m, 0)
+        new_len = lengths + m
+        decoded = st["decoded"] + m
+        last = jnp.take_along_axis(
+            out, jnp.maximum(m - 1, 0)[:, None], axis=1)[:, 0]
+        last = jnp.where(active & (m > 0), last, st["last_tok"])
+        done = active & (
+            (last == st["eos"])
+            | (decoded >= st["max_new"])
+            | (new_len >= self.cache_len)
+        )
+        new_st = dict(st)
+        new_st["last_tok"] = last
+        new_st["lengths"] = jnp.where(active, new_len, lengths)
+        new_st["decoded"] = jnp.where(active, decoded, st["decoded"])
+        new_st["active"] = active & ~done
+        new_st["rng"] = new_rng
+        return cache, new_st, out, m, acc, done
+
+    def _tick_decode_spec(self):
+        """Speculative sibling of :meth:`_tick_decode`: clamp k, grow
+        pages for the verify window, draft, verify once, commit the
+        accepted run per lane. Falls back to the plain tick when no lane
+        has draft headroom (a lane at cache capacity pins the whole
+        tick's k — it is about to retire ``cache_full`` anyway)."""
+        lens = {s: int(self.cache_manager.lengths[s]) for s in self._active}
+        # write-safety clamp: every lane's verify writes land at
+        # lengths..lengths+k, all < cache_len (the per-row update must
+        # never clamp-shift into live rows) — so k is the min headroom.
+        # A lane can only pin k below spec_k while it sits within k
+        # tokens of cache capacity (≤ k ticks before it retires
+        # cache_full), and the verify jit caches per distinct k, so the
+        # throttle is transient and compiles are bounded by spec_k per
+        # engine lifetime.
+        k = min(self.spec_k,
+                min(self.cache_len - 1 - n for n in lens.values()))
+        if k <= 0:
+            return self._tick_decode()
+        retired = []
+        now = self._now()
+        if self.paged:
+            # phase 1: every lane's PENDING-token page first — the exact
+            # allocation the plain tick makes, in the same order, so
+            # cache_full retirement decisions are identical to the
+            # non-speculative engine even under a near-dry pool (draft
+            # windows must never starve a neighbor's pending token)
+            for slot in sorted(self._active):
+                req = self._active[slot]
+                if not self.cache_manager.ensure_page(slot):
+                    self._evict(req, "cache_full", now)
+                    obs_emit("cache_full", request=req.id,
+                             tokens=len(req.tokens))
+                    retired.append(req.id)
+            if not self._active:
+                return retired
+        cov = {}
+        for slot in sorted(self._active):
+            req = self._active[slot]
+            # the PR 11-style budget clamp (ISSUE small fix): a draft may
+            # never overrun the request's remaining token budget or its
+            # page coverage — clamp BEFORE proposing
+            budget = max(req.max_new_tokens - len(req.tokens) - 1, 0)
+            if self.paged:
+                # phase 2: draft windows from whatever slack remains
+                # (uncovered tail writes trash-route; acceptance clamps
+                # to the covered span) — and whatever a draft claims
+                # here is RETURNED by trim_span after the verify, so the
+                # pool a neighbor sees next tick is the plain engine's
+                c = self.cache_manager.ensure_span(
+                    slot, min(k, budget) + 1)
+            else:
+                c = k + 1  # slot lanes are fully allocated
+            cov[slot] = min(k, budget, c - 1)
+        req_map = {
+            slot: (np.concatenate([req.prompt,
+                                   np.asarray(req.tokens, np.int32)]),
+                   cov[slot])
+            for slot, req in self._active.items()
+        }
+        with span("serving.draft", batch=len(req_map), k=k):
+            proposals = self._proposer.propose(req_map, k)
+        draft = np.zeros((self.slots, k), np.int32)
+        dlen = np.zeros(self.slots, np.int32)
+        for slot, (_, cap) in req_map.items():
+            d = np.asarray(proposals.get(slot, ()),
+                           np.int32).reshape(-1)[:cap]
+            draft[slot, :len(d)] = d
+            dlen[slot] = len(d)
+        if not dlen.any():
+            # nothing drafted anywhere (no n-gram match / budgets spent):
+            # a k+1-wide verify would emit exactly one token per lane at
+            # (k+1)x the cost AND skip the flash-decode fast path — take
+            # the plain tick instead (byte-identical for greedy; neither
+            # proposer holds per-tick state that needs an observe() here).
+            # Phase-2 draft pages go back first, so the plain tick and
+            # every neighbor see the plain engine's pool state.
+            if self.paged:
+                for slot in sorted(self._active):
+                    self.cache_manager.trim_span(slot)
+            return retired + self._tick_decode()
+        all_greedy = all(r.greedy for r in self._active.values())
+        active_ids = [r.id for r in self._active.values()]
+        attempt = self._fault_ticks
+        self._fault_ticks += 1
+        # operand binding on the main thread — the same zombie-safety
+        # argument as _tick_decode (an abandoned verify call must never
+        # see post-recovery buffers)
+        cache_in, state_in = self.cache_manager.cache, self._state
+        tables_in = self._device_tables()
+        draft_dev, dlen_dev = jnp.asarray(draft), jnp.asarray(dlen)
+
+        def run():
+            faults.on_serving_tick(attempt)
+            faults.on_serving_batch(active_ids)
+            out = self._verify_jit(self.params, cache_in, state_in,
+                                   tables_in, draft_dev, dlen_dev, k,
+                                   all_greedy)
+            if self.tick_timeout_s > 0:
+                jax.block_until_ready(out)
+            return out
+
+        with span("serving.verify", batch=len(active_ids), k=k):
+            cache, st, out_tok, m, acc, done = self._run_device(run)
+        self.cache_manager.cache = cache
+        self._state = st
+        out_np = np.asarray(out_tok)
+        m_np = np.asarray(m)
+        acc_np = np.asarray(acc)
+        done_np = np.asarray(done)
+        now = self._now()
+        proposed = accepted = 0
+        emitted_rows = []
+        for slot, req in list(self._active.items()):
+            n = int(m_np[slot])
+            toks = [int(t) for t in out_np[slot][:n]]
+            row_acc = min(int(acc_np[slot]), n)
+            proposed += int(dlen[slot])
+            accepted += row_acc
+            req.spec_proposed += int(dlen[slot])
+            req.spec_accepted += row_acc
+            emitted_rows.append(n)
+            self.cache_manager.lengths[slot] += n
+            if self.paged:
+                # return rejected-draft pages to the pool THIS tick:
+                # post-trim the chain matches what the plain engine
+                # would hold, so draft windows cost neighbors nothing
+                self.cache_manager.trim_span(slot)
+            self.metrics.record_tokens(n)
+            self._proposer.observe(slot, n)
+            finished = bool(done_np[slot])
+            failed = False
+            for i, t in enumerate(toks):
+                req.tokens.append(t)
+                # firewalled per-token callback, in emission order; a
+                # raise retires THIS request with the tokens streamed so
+                # far — neighbors keep their whole accepted runs
+                if not self._emit_token(req, t, finished and i == n - 1):
+                    self._retire_error(req, now)
+                    retired.append(req.id)
+                    failed = True
+                    break
+            if failed:
+                continue
+            if finished:
+                if (req.eos_token_id >= 0 and toks
+                        and toks[-1] == req.eos_token_id):
+                    reason = "eos"
+                elif len(req.tokens) >= req.max_new_tokens:
+                    reason = "max_length"
+                else:
+                    reason = "cache_full"
+                self._finalize(req, reason, now)
+                retired.append(req.id)
+        self.metrics.record_spec(proposed, accepted, emitted_rows)
+        return retired
+
     def _emit_token(self, req: Request, tok: int, finished: bool) -> bool:
         """Invoke a request's streaming callback behind a firewall; False
         means the callback raised (the caller retires the request with
@@ -1835,6 +2221,8 @@ class ServingEngine:
         req.chunk_cache = None  # a mid-prefill retiree drops its working
         req.phase = "finished"  # cache; pages/lane free below (no leak)
         if req.slot is not None:  # queued-expiry/cancel never held a slot
+            if self._proposer is not None:
+                self._proposer.on_retire(req.slot)
             self.cache_manager.free(req.slot)
         self.metrics.record_retire(now - req.submit_time, reason)
         self._results[req.id] = ServingResult(

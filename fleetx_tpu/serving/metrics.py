@@ -141,6 +141,22 @@ class ServingMetrics:
             "fleetx_serving_host_evicted_pages_total",
             "Host-tier entries dropped under the byte budget (LRU)")
         self._host_synced = (0, 0, 0)  # last (spilled, revived, evicted)
+        # speculative decoding (docs/SERVING.md): proposer/verifier
+        # throughput — acceptance rate prices the proposer, tokens-per-
+        # tick is the decode multiplier the whole feature exists for
+        self._c_spec_proposed = counter(
+            "fleetx_serving_spec_proposed_tokens_total",
+            "Draft tokens proposed to speculative verification")
+        self._c_spec_accepted = counter(
+            "fleetx_serving_spec_accepted_tokens_total",
+            "Proposed draft tokens the target model accepted")
+        self._g_spec_rate = gauge(
+            "fleetx_serving_spec_acceptance_rate",
+            "Lifetime accepted/proposed draft-token ratio")
+        self._h_spec_tokens = hist(
+            "fleetx_serving_spec_tokens_per_tick",
+            "Tokens emitted per active request per speculative tick "
+            "(accepted drafts + the correction/bonus token)")
         self._g_queue_depth = gauge(
             "fleetx_serving_queue_depth",
             "Requests currently waiting for a decode lane")
@@ -320,6 +336,21 @@ class ServingMetrics:
                 child.inc(delta)
         self._host_synced = now
 
+    def record_spec(self, proposed: int, accepted: int,
+                    emitted_rows) -> None:
+        """One speculative tick: ``proposed``/``accepted`` draft tokens
+        across the batch, ``emitted_rows`` the per-request emitted-token
+        counts (each feeds the tokens-per-tick histogram)."""
+        if proposed > 0:
+            self._c_spec_proposed.inc(proposed)
+        if accepted > 0:
+            self._c_spec_accepted.inc(accepted)
+        total = int(self._c_spec_proposed.value)
+        self._g_spec_rate.set(
+            int(self._c_spec_accepted.value) / total if total else 0.0)
+        for n in emitted_rows:
+            self._h_spec_tokens.observe(int(n))
+
     def observe_pages(self, pages_in_use: int, pages_total: int) -> None:
         """Per-tick page-pool gauge sample (paged mode only)."""
         self._g_pages_in_use.set(pages_in_use)
@@ -462,6 +493,16 @@ class ServingMetrics:
         return int(self._c_host_evicted.value)
 
     @property
+    def spec_proposed_tokens(self) -> int:
+        """Draft tokens proposed to speculative verification."""
+        return int(self._c_spec_proposed.value)
+
+    @property
+    def spec_accepted_tokens(self) -> int:
+        """Proposed draft tokens the target model accepted."""
+        return int(self._c_spec_accepted.value)
+
+    @property
     def queue_depth(self) -> int:
         """Last sampled queue depth."""
         return int(self._g_queue_depth.value)
@@ -579,6 +620,13 @@ class ServingMetrics:
             "kv_bytes_per_token": int(self._g_kv_bytes.value),
             "weight_bytes": int(self._g_weight_bytes.value),
             "kv_cache_bytes": int(self._g_kv_cache_bytes.value),
+            # speculative-decoding story (docs/SERVING.md): what the
+            # proposer offered, what verification kept, and the
+            # resulting decode multiplier (1.0 mean = nothing accepted)
+            "spec_proposed_tokens": self.spec_proposed_tokens,
+            "spec_accepted_tokens": self.spec_accepted_tokens,
+            "spec_acceptance_rate": float(self._g_spec_rate.value),
+            "spec_tokens_per_tick_mean": self._h_spec_tokens.mean,
             # crash-safety story: how often the engine recovered, what it
             # quarantined, what shutdown turned away, and what a tick costs
             "engine_recoveries": self.engine_recoveries,

@@ -67,6 +67,12 @@ class Request:
     phase: str = "queued"
     prefill_pos: int = 0
     chunk_cache: Any = dataclasses.field(default=None, repr=False)
+    # speculative-decoding draft accounting (docs/SERVING.md): lifetime
+    # proposed/accepted draft tokens for THIS request — also snapshot-
+    # covered, so a tick that faults mid-verify rolls its counts back
+    # with its tokens and recovery replay stays byte-identical
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
     @property
     def prompt_len(self) -> int:

@@ -484,7 +484,11 @@ def test_prefix_reuse_cuts_prefill_and_pages(model_and_params):
     assert eng.cache_manager.pages_in_use == 0  # drained clean
 
 
+@pytest.mark.slow  # ~10s (PR 13 tier-1 budget audit): the pages-not-slots
 def test_page_granular_admission(model_and_params):
+    # admission contract stays tier-1 via test_pool_exhaustion_retires_
+    # cache_full (page-gated admission + starvation) and the shared-
+    # prefix admission tests; the bench schema test asserts occupancy
     """Acceptance: a workload whose LIVE tokens fit the pool is admitted
     concurrently even though it could never fit as max-length slots (4
     requests x 2 pages = 8 pages vs 4 slots x 56-token worst case)."""

@@ -31,7 +31,11 @@ def test_quantize_tree_skips_non_weight_leaves():
 
 # ----------------------------------------------- imagen SR serving contract
 
+@pytest.mark.slow  # ~20s (PR 13 tier-1 budget audit): two diffusion-UNet
 def test_sr_serving_takes_explicit_lowres_input():
+    # forwards; lowres conditioning stays tier-1 via test_imagen.py::
+    # test_sr_unet_lowres_conditioning and the serving-export contract
+    # via test_imagen.py::test_imagen_export_serving_contract
     from fleetx_tpu.models import build_module
 
     cfg = AttrDict(

@@ -92,9 +92,9 @@ def test_bench_serving_records_schema(monkeypatch):
         "gpt_345m_serving_static", "gpt_345m_serving_continuous",
         "gpt_345m_serving_shared_prefix", "gpt_345m_serving_faulted",
         "gpt_345m_serving_int8", "gpt_345m_serving_chunked",
-        "gpt_345m_serving_page_sweep",
+        "gpt_345m_serving_spec", "gpt_345m_serving_page_sweep",
     ]
-    static, cont, shared, faulted, int8, chunked, sweep = recs
+    static, cont, shared, faulted, int8, chunked, spec, sweep = recs
     for r in recs:
         assert r["unit"] == "tokens/s"
         assert np.isfinite(r["value"]) and r["value"] > 0
@@ -162,6 +162,23 @@ def test_bench_serving_records_schema(monkeypatch):
             > sp["prefix_hit_rate_host_off"])
     assert (sp["prefill_tokens_saved_host_on"]
             > sp["prefill_tokens_saved_host_off"])
+    # the speculative record: byte parity vs the non-speculative engine,
+    # a real multi-token multiplier (mean tokens-per-tick > 1 is the
+    # acceptance gate), the proposer economics (acceptance rate,
+    # proposed/accepted counters), a measured speedup-vs-baseline (a
+    # harness number at TINY sizes — the per-tick host sync dominates
+    # toy models; the perf claim is the TPU window's), and the k sweep
+    d = spec["detail"]
+    assert d["parity"] is True and d["proposer"] == "ngram"
+    assert d["spec_k"] == 4
+    assert d["tokens_per_tick_mean"] > 1
+    assert 0 < d["acceptance_rate"] <= 1
+    assert d["spec_accepted_tokens"] <= d["spec_proposed_tokens"]
+    assert d["speedup_vs_baseline"] > 0
+    assert d["ttft_ms_p50_baseline"] > 0
+    assert [s["k"] for s in d["k_sweep"]] == [2, 4, 8]
+    for s in d["k_sweep"]:
+        assert s["tokens_per_s"] > 0 and s["tokens_per_tick_mean"] > 1
     # the page sweep ran its swept size byte-identically and picked it
     # (one size in the smoke — the tier-1 budget pays per swept size;
     # the multi-size comparison is the TPU window's job)
@@ -276,13 +293,15 @@ def test_chaos_check_serving_recovery_scenarios(tmp_path, capsys):
     # contract here is tier-1 via tests/test_serving_recovery.py; this
     # proves the CLI driver end-to-end (same precedent as the spill smoke)
     """The serving crash-safety scenarios (recovery, poison quarantine,
-    hung-tick watchdog, graceful drain) pass through the CLI driver and
-    print one PASS line each — the acceptance-gate demonstration outside
-    pytest (the full suite is tests/test_serving_recovery.py)."""
+    hung-tick watchdog, graceful drain, mid-verify speculative fault)
+    pass through the CLI driver and print one PASS line each — the
+    acceptance-gate demonstration outside pytest (the full suites are
+    tests/test_serving_recovery.py and tests/test_spec_serving.py)."""
     sys.path.insert(0, REPO)
     import tools.chaos_check as cc
 
-    names = "serving_recovery,serving_poison,serving_hang,serving_drain"
+    names = ("serving_recovery,serving_poison,serving_hang,serving_drain,"
+             "serving_spec")
     rc = cc.main(["--only", names, "--workdir", str(tmp_path)])
     out = capsys.readouterr().out
     assert rc == 0, out

@@ -55,6 +55,15 @@ collapses on revisit), with it spilled pages revive from host DRAM and
 the hit rate holds — byte parity asserted, spill/revive/byte counters
 reported.
 
+A seventh record (`spec`) prices SPECULATIVE DECODING (docs/SERVING.md):
+a repetitive motif workload (the template/code-edit shape where n-gram /
+prompt-lookup drafting shines) run through a baseline engine and a
+`FLEETX_SERVING_SPEC=1` engine at the default k — greedy byte parity
+ASSERTED, mean tokens-per-tick > 1 asserted, tokens/s speedup vs
+baseline, acceptance rate, and baseline-vs-spec TTFT reported, plus a
+`detail.k_sweep` over `FLEETX_SERVING_SPEC_K` ∈ {2, 4, 8} (each swept k
+byte-identical too).
+
 `BENCH_SERVING_PAGE_SIZES=16,32,64` appends a page-size sweep record
 (`page_sweep`): the continuous workload re-run per page size so a TPU
 window can pick a DMA-tuned default over the correctness-tuned 16
@@ -151,6 +160,22 @@ def _chunked_workload(n: int):
         plen = long_len if i % 2 == 0 else short_len
         gen = rng.randint(GEN_RANGE[0], GEN_RANGE[1] + 1)
         out.append((rng.randint(0, VOCAB, plen).astype(np.int32), int(gen)))
+    return out
+
+
+def _repetitive_workload(n: int):
+    """Motif-tiled prompts decoding EOS-free to the max length: the
+    repetitive/template shape where prompt-lookup (n-gram) drafting
+    shines — the continuation keeps re-appearing verbatim in the
+    request's own prompt + generated history."""
+    rng = np.random.RandomState(6)
+    motif_len = 4 if _TINY else 16
+    out = []
+    for _ in range(n):
+        motif = rng.randint(0, VOCAB, motif_len).astype(np.int32)
+        reps = -(-PROMPT_RANGE[1] // motif_len)
+        prompt = np.tile(motif, reps)[:PROMPT_RANGE[1]].astype(np.int32)
+        out.append((prompt, int(GEN_RANGE[1])))
     return out
 
 
@@ -571,6 +596,67 @@ def serving_records(n_requests: int = N_REQUESTS, slots: int = SLOTS):
     ck_detail["dead_token_frac"] = 0.0
     ck_detail["generated_tokens"] = ck_detail["useful_tokens"]
 
+    # speculative mode: draft-k-verify-once ticks (docs/SERVING.md) on a
+    # repetitive workload the n-gram proposer can actually draft for —
+    # byte parity vs the non-speculative engine asserted at every k, the
+    # tokens-per-tick multiplier and acceptance rate are the story, and
+    # TTFT rides along to show admission latency is untouched (drafting
+    # only changes the decode tick)
+    rep_workload = _repetitive_workload(n_requests)
+
+    def _spec_engine(spec, k):
+        return ServingEngine(model, variables, slots=slots,
+                             cache_len=model.cfg.max_position_embeddings,
+                             gen_cfg=gen_cfg,
+                             prefill_bucket=8 if _TINY else 32,
+                             spec=spec, spec_k=k)
+
+    spec_base_eng = _spec_engine(False, 4)
+    if not _TINY:  # TINY only schema-checks; compile time in the
+        _run_continuous(spec_base_eng, rep_workload)  # speedup is OK there
+    sb_toks, _, sb_detail = _run_continuous(spec_base_eng, rep_workload)
+    sb_tps = sb_detail["useful_tokens"] / sb_detail["elapsed_s"]
+    k_sweep = []
+    spec_detail = None
+    for kk in (2, 4, 8):
+        eng = _spec_engine(True, kk)
+        if not _TINY:
+            _run_continuous(eng, rep_workload)  # compile warmup
+        toks, _, d = _run_continuous(eng, rep_workload)
+        assert all(np.array_equal(a, b) for a, b in zip(sb_toks, toks)), (
+            f"speculative decoding (k={kk}) broke greedy byte parity")
+        snap = d["obs_snapshot"]
+        tps = d["useful_tokens"] / d["elapsed_s"]
+        k_sweep.append({
+            "k": kk,
+            "tokens_per_s": round(tps, 1),
+            "speedup_vs_baseline": round(tps / sb_tps, 3),
+            "acceptance_rate": round(snap["spec_acceptance_rate"], 3),
+            "tokens_per_tick_mean": (
+                None if snap["spec_tokens_per_tick_mean"] is None
+                else round(snap["spec_tokens_per_tick_mean"], 2)),
+            "ttft_ms_p50": d["ttft_ms_p50"],
+        })
+        if kk == 4:  # the record's headline run: the default k
+            spec_detail = d
+            spec_detail.update({
+                "parity": True,
+                "spec_k": kk,
+                "proposer": "ngram",
+                "speedup_vs_baseline": round(tps / sb_tps, 3),
+                "acceptance_rate": round(snap["spec_acceptance_rate"], 3),
+                "spec_proposed_tokens": snap["spec_proposed_tokens"],
+                "spec_accepted_tokens": snap["spec_accepted_tokens"],
+                "tokens_per_tick_mean": round(
+                    snap["spec_tokens_per_tick_mean"], 2),
+                "ttft_ms_p50_baseline": sb_detail["ttft_ms_p50"],
+                "elapsed_s_baseline": sb_detail["elapsed_s"],
+            })
+    assert spec_detail["tokens_per_tick_mean"] > 1, (
+        "speculative ticks averaged <= 1 token per request per tick — "
+        f"the draft path gained nothing ({spec_detail})")
+    spec_detail["k_sweep"] = k_sweep
+
     # shared-prefix mode: paged engine, trie-cold warmup then warm timing
     sp_workload = _shared_prefix_workload(n_requests)
     sp_engine = ServingEngine(model, variables, slots=slots,
@@ -594,7 +680,8 @@ def serving_records(n_requests: int = N_REQUESTS, slots: int = SLOTS):
              ("shared_prefix", sp_detail),
              ("faulted", fault_detail),
              ("int8", int8_detail),
-             ("chunked", ck_detail)]
+             ("chunked", ck_detail),
+             ("spec", spec_detail)]
 
     # page-size sweep (ROADMAP item 1 follow-up): opt-in via
     # BENCH_SERVING_PAGE_SIZES so a TPU window can pick a DMA-tuned

@@ -24,6 +24,14 @@ per scenario, non-zero exit on any failure:
   watchdog, diagnostics are banked, recovery keeps parity;
 - ``serving_drain``: shutdown() under load returns EVERY request with a
   terminal finish_reason (partials kept) and rejects new submits;
+- ``serving_spec``: a fault injected during a SPECULATIVE verify call
+  (``FLEETX_FAULT_TICK_RAISE`` — with ``FLEETX_SERVING_SPEC=1`` the
+  verify call is the decode device call): the transactional rollback
+  drops the un-verified draft (per-request draft counters included),
+  replay recovery resumes with speculation still enabled, and the
+  streams stay byte-identical to BOTH a clean speculative run and the
+  non-speculative engine (tick_fault / engine_recovery / spec_enabled
+  events asserted);
 - ``serving_spill``: the two-level page cache under a mid-chunk fault —
   a warm prefix spills to the host-DRAM tier under pool pressure, a
   chunked-prefill request reviving it is killed mid-chunk, the tick
@@ -497,6 +505,51 @@ def scenario_serving_drain(tmp):
             "shutdown + drain_reject events banked")
 
 
+def scenario_serving_spec(tmp):
+    """Fault during a speculative verify call: rollback drops the
+    un-verified draft, recovery replays byte-identically with the
+    speculative path still enabled."""
+    import numpy as np
+
+    from fleetx_tpu.resilience.faults import faults
+
+    make, prompts = _serving_fixture()
+    plain, _, _ = _run_workload(make(True), prompts)
+    clean_eng = make(True, spec=True, spec_k=4)
+    clean, _, _ = _run_workload(clean_eng, prompts)
+    # speculation must not move a byte even before any fault
+    assert all(np.array_equal(a, b) for a, b in zip(plain, clean)), \
+        "speculative engine diverged from the plain engine"
+    faults.configure(tick_raise="1")  # the first verify attempt dies
+    try:
+        eng = make(True, spec=True, spec_k=4)
+        faulty, _, _ = _run_workload(eng, prompts)
+    finally:
+        faults.reset()
+    assert eng.metrics.engine_recoveries == 1, eng.metrics.snapshot()
+    assert all(np.array_equal(a, b) for a, b in zip(clean, faulty)), \
+        "tokens diverged after a mid-verify fault + recovery"
+    eng.cache_manager.pool.check_invariants()
+    snap = eng.metrics.snapshot()
+    # the post-recovery engine kept speculating: drafts were proposed
+    # and accepted across the fault, not silently disabled
+    assert snap["spec_proposed_tokens"] > 0, snap
+    assert snap["spec_tokens_per_tick_mean"] is not None, snap
+    from fleetx_tpu.obs import get_event_log
+
+    ev = get_event_log()
+    assert ev.find("spec_enabled"), "speculation left no spec_enabled event"
+    faults_banked = ev.find("tick_fault")
+    assert faults_banked and not faults_banked[-1].attrs["during_prefill"], \
+        "the injected verify fault was not banked as a decode-phase fault"
+    assert ev.find("engine_recovery"), "recovery left no structured event"
+    return ("mid-verify fault rolled back the un-verified draft; recovery "
+            "replayed byte-identically with speculation still on "
+            f"(acceptance_rate={snap['spec_acceptance_rate']:.2f}, "
+            f"tokens_per_tick_mean={snap['spec_tokens_per_tick_mean']:.2f}, "
+            "events banked)")
+
+
 def scenario_serving_spill(tmp):
     """Mid-chunk fault over the two-level page cache: rollback +
     requeue, host tier survives, revived pages reused, byte parity."""
@@ -588,6 +641,7 @@ SCENARIOS = {
     "serving_poison": scenario_serving_poison,
     "serving_hang": scenario_serving_hang,
     "serving_drain": scenario_serving_drain,
+    "serving_spec": scenario_serving_spec,
     "serving_spill": scenario_serving_spill,
 }
 
