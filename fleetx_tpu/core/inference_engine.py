@@ -138,13 +138,17 @@ class InferenceEngine:
         (requires the module to be a GPTGenerationModule export).
 
         Servable requests (greedy/sampling, no repetition penalty / forced
-        EOS, no mesh) delegate to the continuous-batching
+        EOS) delegate to the continuous-batching
         :class:`~fleetx_tpu.serving.ServingEngine` — same [b, prompt+max]
         token buffer, but rows retire independently and the engine is
         shared with any concurrent ``serving_engine()`` traffic pattern;
         ``FLEETX_SERVING_DELEGATE=0`` forces the legacy one-shot loop.
-        Beam search and penalty requests always run one-shot, sharded over
-        ``self.mesh`` exactly like ``predict()`` when a mesh was given.
+        A ``mesh`` rides into the delegate engine (mesh-native serving,
+        docs/SERVING.md "Mesh-sharded serving") when delegating wins —
+        an (fsdp, mp) mesh with the heads dividing over mp; dp>1 meshes
+        (whose batch the one-shot path genuinely shards), pp/cp meshes,
+        beam search, and penalty requests run one-shot, sharded over
+        ``self.mesh`` exactly like ``predict()``.
 
         Each call folds a call counter into the sampling key, so repeated
         sampling requests draw fresh tokens; pass an explicit ``seed``
@@ -173,9 +177,9 @@ class InferenceEngine:
         serving_cap = min(
             int(os.environ.get("FLEETX_SERVING_CACHE_LEN", 0) or max_pos),
             max_pos)
-        if (self.mesh is None
-                and os.environ.get("FLEETX_SERVING_DELEGATE", "1") != "0"
+        if (os.environ.get("FLEETX_SERVING_DELEGATE", "1") != "0"
                 and self._servable(gcfg)
+                and self._serving_mesh_ok()
                 and ids.shape[-1] + gcfg.max_length <= serving_cap):
             return self._serving_engine(gcfg).generate_batch(
                 ids, gcfg, rng=rng)
@@ -207,6 +211,25 @@ class InferenceEngine:
                 and gcfg.forced_eos_token_id is None
                 and gcfg.num_return_sequences == 1)
 
+    def _serving_mesh_ok(self) -> bool:
+        """True when delegating ``self.mesh`` to the mesh-native
+        ServingEngine is both covered AND a win: none at all, or an
+        (fsdp, mp) mesh whose mp extent divides the attention heads (the
+        engine's cache-sharding contract). pp/cp meshes and non-dividing
+        heads would raise at engine construction; a dp>1 mesh is covered
+        but a LOSS — the serving tick replicates over dp while the
+        one-shot path genuinely batch-shards it — so both keep the
+        one-shot path."""
+        if self.mesh is None:
+            return True
+        shape = dict(self.mesh.shape)
+        cfg = getattr(getattr(self.module, "nets", None), "cfg", None)
+        heads = getattr(cfg, "num_attention_heads", None)
+        return (shape.get("pp", 1) == 1 and shape.get("cp", 1) == 1
+                and shape.get("dp", 1) == 1
+                and heads is not None
+                and heads % shape.get("mp", 1) == 0)
+
     def _serving_engine(self, gcfg):
         # built with the first servable call's config (engine-level
         # defaults only — generate_batch passes per-call configs anyway)
@@ -217,13 +240,18 @@ class InferenceEngine:
     def serving_engine(self, **kwargs):
         """Build a continuous-batching :class:`ServingEngine` over this
         artifact's module + params (kwargs forward: slots, cache_len,
-        gen_cfg, ...). The engine handed back owns its own slot cache;
-        call it directly for submit/step/drain streaming serving."""
+        gen_cfg, ...). ``self.mesh`` rides along by default (the engine
+        shards params + kv caches over it — docs/SERVING.md
+        "Mesh-sharded serving"); pass ``mesh=None`` to opt a meshed
+        InferenceEngine's serving side out. The engine handed back owns
+        its own cache; call it directly for submit/step/drain streaming
+        serving."""
         from fleetx_tpu.models.gpt.generation import GenerationConfig
         from fleetx_tpu.serving import ServingEngine
 
         if "gen_cfg" not in kwargs:
             kwargs["gen_cfg"] = GenerationConfig.from_config(
                 dict(self.cfg.get("Generation") or {}))
+        kwargs.setdefault("mesh", self.mesh)
         return ServingEngine(self.module.nets, {"params": self.params},
                              **kwargs)

@@ -237,6 +237,7 @@ class SelfAttention(nn.Module):
                         starts=self._pad_starts(kv_pad_mask, q.shape[0]),
                         k_scale=kv_scales and kv_scales[0],
                         v_scale=kv_scales and kv_scales[1],
+                        mesh=self._decode_shard_mesh(),
                     )
                     out = checkpoint_name(out, "core_attn_out")
                     return self._out_proj(out)
@@ -254,7 +255,7 @@ class SelfAttention(nn.Module):
                         v, paged_gather_kv(kv_scales[1], tables), q.dtype)
                     kv_scales = None
             elif decode_end is not None and self._flash_decode_ok(
-                kv_pad_mask, k.shape[1], deterministic
+                kv_pad_mask, k.shape[1], deterministic, batch=q.shape[0]
             ):
                 # Single-query fast path: the Pallas flash-decode kernel reads
                 # only the KV blocks inside [starts, cache_index) — per-step
@@ -269,6 +270,7 @@ class SelfAttention(nn.Module):
                     starts=self._pad_starts(kv_pad_mask, q.shape[0]),
                     k_scale=kv_scales and kv_scales[0],
                     v_scale=kv_scales and kv_scales[1],
+                    mesh=self._decode_shard_mesh(),
                 )
                 out = checkpoint_name(out, "core_attn_out")
                 return self._out_proj(out)
@@ -555,21 +557,31 @@ class SelfAttention(nn.Module):
         return k, v, attn_mask, decode_end, paged, kv_scales
 
     def _flash_decode_ok(self, kv_pad_mask, cache_len: int,
-                         deterministic: bool, tile_len: Optional[int] = None
-                         ) -> bool:
+                         deterministic: bool, tile_len: Optional[int] = None,
+                         batch: Optional[int] = None) -> bool:
         """Static dispatch check for the single-query flash-decode path.
 
         The kernel handles exactly the generation-loop mask shape: an
         optional [b, 1, 1, cache_len] key-validity mask whose False slots
         are the contiguous left-pad prefix (generate()/beam_search() build
         exactly this). Anything else — arbitrary masks, attention dropout,
-        untileable cache lengths, an ambient multi-device mesh (the bare
-        Pallas call would make GSPMD replicate the sharded operands) —
-        falls back to the dense XLA path.
+        untileable cache lengths — falls back to the dense XLA path.
+
+        An ambient multi-device mesh no longer forces the fallback (the
+        PR 1 guard): when the heads divide over the ``mp`` extent the
+        kernels run per-shard inside ``shard_map`` over the local head
+        slice (``mesh=`` on the kernel entry points). Meshes whose mp
+        does not divide the heads — or, on the CONTIGUOUS layout, whose
+        dp/fsdp extent does not divide ``batch`` (one-shot callers keep
+        the cache batch-sharded over those axes; a shard_map that
+        replicated it would all-gather the cache per step) — still fall
+        back to the dense path.
 
         ``tile_len`` is the buffer length the kernel must tile: the page
         size on the paged path (one page is the DMA/gather unit there),
-        defaulting to ``cache_len`` on the contiguous path."""
+        defaulting to ``cache_len`` on the contiguous path. ``batch``
+        engages the data-axis divisibility check (contiguous layout
+        only — the paged pools are serving-owned and batch-replicated)."""
         cfg = self.cfg
         if not cfg.use_flash_attention:
             return False
@@ -582,14 +594,28 @@ class SelfAttention(nn.Module):
             or kv_pad_mask.shape[3] != cache_len
         ):
             return False
-        from fleetx_tpu.ops.pallas.decode_attention import decode_flash_supported
-        from fleetx_tpu.parallel.mesh import ambient_mesh
+        from fleetx_tpu.ops.pallas.decode_attention import (
+            decode_flash_supported,
+            decode_mesh_shardable,
+        )
 
-        mesh = ambient_mesh()
-        if mesh is not None and mesh.size > 1:
+        mesh = self._decode_shard_mesh()
+        if mesh is not None and not decode_mesh_shardable(
+                mesh, cfg.num_attention_heads, batch):
             return False
         return decode_flash_supported(
             cache_len if tile_len is None else tile_len)
+
+    @staticmethod
+    def _decode_shard_mesh():
+        """The ambient mesh the flash-decode kernels shard_map over, or
+        None for the bare (single-device) kernel call."""
+        from fleetx_tpu.parallel.mesh import ambient_mesh
+
+        mesh = ambient_mesh()
+        if mesh is None or mesh.size <= 1:
+            return None
+        return mesh
 
     @staticmethod
     def _pad_starts(kv_pad_mask, batch: int):

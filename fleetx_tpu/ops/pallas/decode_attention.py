@@ -41,6 +41,18 @@ softmax math is bit-identical to dequantizing up front. The dense/XLA
 fallback uses the same ``dequantize_kv`` helper, keeping every path on
 one quantization contract (docs/QUANTIZATION.md).
 
+Mesh-sharded decode (``mesh=`` on both entry points): under a TP/FSDP
+serving mesh the KV cache lives head-sharded on ``mp``
+(serving/engine.py "Mesh-sharded serving"), and a bare Pallas call over
+sharded operands would make GSPMD replicate them — an all-gather of the
+whole pool per step, defeating the kernel. Instead the call is wrapped
+in ``shard_map`` over the local head slice: per-head online softmax is
+independent across heads, so each device streams only ITS heads' live
+prefix (the HBM-traffic contract holds per device) and the result is
+bit-identical to the unsharded kernel. ``starts``/``ends`` and the
+paged block tables are replicated; the logits all-gather happens only
+at the row-parallel output projection GSPMD already manages.
+
 Paged variant (:func:`flash_decode_paged_attention`): the serving engine's
 page-granular cache stores K/V as ``[num_pages, page_size, h, d]`` shared
 pages and each batch row addresses its logical window through a block
@@ -78,6 +90,7 @@ __all__ = [
     "flash_decode_attention",
     "flash_decode_paged_attention",
     "decode_flash_supported",
+    "decode_mesh_shardable",
     "fit_decode_blocks",
     "paged_gather_kv",
 ]
@@ -121,6 +134,110 @@ def decode_flash_supported(cache_len: int) -> bool:
         jax.default_backend() in ("tpu", "axon")
         or _os.environ.get("FLEETX_FORCE_FLASH") == "1"
     )
+
+
+def _data_extent(mesh) -> int:
+    """dp*fsdp world of a mesh — the axes one-shot callers batch-shard
+    activations (and decode caches) over."""
+    sizes = dict(mesh.shape)
+    return sizes.get("dp", 1) * sizes.get("fsdp", 1)
+
+
+def decode_mesh_shardable(mesh, num_heads: int,
+                          batch: Optional[int] = None) -> bool:
+    """True when the decode kernels can run per-shard under ``mesh``
+    (module docstring "Mesh-sharded decode"): no pp/cp extents (the
+    shard_map's specs would treat those axes as replicated, all-gathering
+    pipeline-stage or cp-sharded operands around the kernel), the
+    attention heads must divide over the ``mp`` extent, and — when the
+    mesh has dp/fsdp extents and the caller supplied ``batch`` — the
+    batch must divide over them too. One-shot ``generate()`` under a
+    data-parallel mesh keeps its cache batch-sharded over (dp, fsdp); a
+    shard_map that replicated that axis would all-gather the whole cache
+    per step (the exact pathology the old dense fallback avoided), so a
+    non-dividing batch keeps the dense path. The per-head/per-row
+    online-softmax walk is embarrassingly parallel, so a sliced kernel
+    call is bit-identical to the unsharded one."""
+    sizes = dict(mesh.shape)
+    if sizes.get("pp", 1) > 1 or sizes.get("cp", 1) > 1:
+        return False
+    if num_heads % sizes.get("mp", 1):
+        return False
+    n_data = _data_extent(mesh)
+    return n_data == 1 or batch is None or batch % n_data == 0
+
+
+def _decode_specs(mesh, batch: Optional[int]):
+    """(batch axes, operand spec) for the decode shard_map: heads on mp
+    (all rank-4 operands — q, K/V slots or pages, and the [..., h, 1]
+    scales — carry heads at axis 2), batch over (dp, fsdp) when
+    ``batch`` is given and divides. Sharding a replicated operand
+    merely slices it; the guard in :func:`decode_mesh_shardable` keeps
+    the reverse (replicating a batch-sharded cache = a per-step
+    all-gather) off this path. ``batch=None`` = never shard axis 0
+    (the paged pools' page axis is shared by every row)."""
+    from jax.sharding import PartitionSpec as P
+
+    sizes = dict(mesh.shape)
+    head = "mp" if sizes.get("mp", 1) > 1 else None
+    data = tuple(a for a in ("dp", "fsdp") if sizes.get(a, 1) > 1)
+    if batch is None or not batch or (data and batch % _data_extent(mesh)):
+        data = ()  # direct callers without the guard: replicate batch
+    batch_axes = data or None
+    return batch_axes, P(batch_axes, None, head, None)
+
+
+def _sharded_decode(mesh, starts_b, ends_b, operands, tables=None,
+                    block_k=None, block_major=None):
+    """shard_map both decode kernels over (heads -> mp; contiguous
+    batch -> dp/fsdp when it divides). Without this, GSPMD treats the
+    Pallas call as an opaque custom call and REPLICATES the sharded
+    q/cache operands — an all-gather of the whole KV pool around the
+    one kernel whose purpose is to bound HBM traffic (the PR 1
+    "meshes -> dense XLA fallback" guard existed exactly because of
+    that). The manual region hands each device its local slice;
+    ``starts``/``ends`` follow the batch axes, and the per-row/per-head
+    math is the unsharded kernel's bit-for-bit, so mesh serving keeps
+    byte parity.
+
+    ``operands`` is [q, k, v] (+ [k_scale, v_scale] at int8); ``tables``
+    flips the paged variant on. Scale operands share the K/V head axis
+    ([..., h, 1]), so one spec serves all five. Batch layouts differ:
+    the CONTIGUOUS buffers carry batch at axis 0, matching one-shot
+    ``generate()``'s dp/fsdp-sharded cache (:func:`decode_mesh_shardable`
+    keeps non-dividing batches off this path); the PAGED pools carry
+    PAGES at axis 0 — shared by every row's table — so the paged
+    variant (serving-only, batch replicated by design) never shards it."""
+    from jax.sharding import PartitionSpec as P
+
+    from fleetx_tpu.parallel.mesh import shard_map
+
+    if tables is None:
+        batch_axes, spec = _decode_specs(mesh, operands[0].shape[0])
+
+        def body(starts, ends, q, k, v, *scales):
+            ks, vs = scales if scales else (None, None)
+            return flash_decode_attention(
+                q, k, v, end=ends, starts=starts, block_k=block_k,
+                block_major=block_major, k_scale=ks, v_scale=vs)
+
+        in_specs = (P(batch_axes), P(batch_axes)) + (spec,) * len(operands)
+        fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=spec,
+                       check_vma=False)
+        return fn(starts_b, ends_b, *operands)
+
+    _, spec = _decode_specs(mesh, None)  # heads-only: pool axis stays whole
+
+    def pbody(starts, ends, tables, q, k, v, *scales):
+        ks, vs = scales if scales else (None, None)
+        return flash_decode_paged_attention(
+            q, k, v, tables=tables, end=ends, starts=starts,
+            block_k=block_k, k_scale=ks, v_scale=vs)
+
+    in_specs = (P(None), P(None), P(None, None)) + (spec,) * len(operands)
+    fn = shard_map(pbody, mesh=mesh, in_specs=in_specs, out_specs=spec,
+                   check_vma=False)
+    return fn(starts_b, ends_b, tables, *operands)
 
 
 def _decode_kernel(starts_ref, ends_ref, q_ref, k_ref, v_ref, o_ref,
@@ -252,6 +369,7 @@ def flash_decode_attention(
     block_major: Optional[int] = None,
     k_scale: Optional[jax.Array] = None,
     v_scale: Optional[jax.Array] = None,
+    mesh=None,
 ) -> jax.Array:
     """Single-query attention against the kv cache, [b, 1, h, d] layout.
 
@@ -269,12 +387,24 @@ def flash_decode_attention(
 
     ``cache_len`` must be a multiple of 8 (checked; callers pre-screen with
     :func:`decode_flash_supported` and take the XLA path otherwise).
+
+    ``mesh`` invokes the kernel per-shard inside ``shard_map`` over the
+    local head slice (:func:`_sharded_decode`): heads split on ``mp``,
+    scalars/tables replicated — callers pre-screen with
+    :func:`decode_mesh_shardable`.
     """
     b, sq, h, d = q.shape
     if sq != 1:
         raise ValueError(f"flash decode is single-query (q_len={sq})")
     if (k_scale is None) != (v_scale is None):
         raise ValueError("int8 KV needs BOTH k_scale and v_scale")
+    if mesh is not None and mesh.size > 1:
+        ends_b = jnp.broadcast_to(jnp.asarray(end, jnp.int32), (b,))
+        starts_b = (jnp.zeros((b,), jnp.int32) if starts is None
+                    else starts.astype(jnp.int32))
+        ops = [q, k, v] + ([k_scale, v_scale] if k_scale is not None else [])
+        return _sharded_decode(mesh, starts_b, ends_b, ops,
+                               block_k=block_k, block_major=block_major)
     cache_len = k.shape[1]
     block_k, major = fit_decode_blocks(cache_len, block_k, block_major)
     if block_k is None:
@@ -403,6 +533,7 @@ def flash_decode_paged_attention(
     block_k: Optional[int] = None,
     k_scale: Optional[jax.Array] = None,
     v_scale: Optional[jax.Array] = None,
+    mesh=None,
 ) -> jax.Array:
     """Single-query attention against a PAGED kv cache.
 
@@ -422,12 +553,23 @@ def flash_decode_paged_attention(
     ``page_size`` must be a multiple of 8 (callers pre-screen with
     :func:`decode_flash_supported` on the page size); ``block_k`` tiles
     within a page (largest divisor wins, as in the contiguous kernel).
+    ``mesh`` runs the kernel per-shard over the local head slice of the
+    page pools (tables replicated) — see :func:`flash_decode_attention`.
     """
     b, sq, h, d = q.shape
     if sq != 1:
         raise ValueError(f"flash decode is single-query (q_len={sq})")
     if (k_scale is None) != (v_scale is None):
         raise ValueError("int8 KV needs BOTH k_scale and v_scale")
+    if mesh is not None and mesh.size > 1:
+        ends_b = jnp.broadcast_to(jnp.asarray(end, jnp.int32), (b,))
+        starts_b = (jnp.zeros((b,), jnp.int32) if starts is None
+                    else starts.astype(jnp.int32))
+        ops = ([q, k_pages, v_pages]
+               + ([k_scale, v_scale] if k_scale is not None else []))
+        return _sharded_decode(mesh, starts_b, ends_b, ops,
+                               tables=tables.astype(jnp.int32),
+                               block_k=block_k)
     page_size = k_pages.shape[1]
     # major is pinned to one page (the gather unit); block_k tiles inside
     block_k, major = fit_decode_blocks(page_size, block_k, page_size)

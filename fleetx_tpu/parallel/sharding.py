@@ -30,6 +30,7 @@ __all__ = [
     "make_rules",
     "logical_to_mesh_sharding",
     "param_shardings",
+    "serving_param_shardings",
     "with_logical_constraint",
     "zero_update_spec",
 ]
@@ -112,6 +113,57 @@ def _spec_axes(entry) -> Tuple[str, ...]:
     if isinstance(entry, tuple):
         return tuple(a for a in entry if a)
     return (entry,)
+
+
+def _fit_spec(spec: P, shape, mesh: Mesh) -> P:
+    """Clamp a PartitionSpec to the dims it evenly divides: entries whose
+    mesh-axis product does not divide the dimension are dropped
+    (replicated) instead of erroring — a prime vocab under mp2, or the
+    size-1 dims of a per-channel quantization scale, simply stay whole."""
+    parts = []
+    for i, dim in enumerate(shape):
+        entry = spec[i] if i < len(spec) else None
+        factor = math.prod(int(mesh.shape[a]) for a in _spec_axes(entry))
+        parts.append(entry if dim % factor == 0 else None)
+    return P(*parts)
+
+
+def serving_param_shardings(abstract_params, params, mesh: Mesh,
+                            rules: Rules):
+    """Per-leaf NamedShardings for a SERVED (inference) param tree.
+
+    ``abstract_params`` is the module's ``eval_shape`` init — its
+    ``nn.Partitioned`` metadata is the source of each param's logical
+    spec; ``params`` is the tree actually served, which may be unboxed
+    and may carry int8-quantized ``{"_q8", "_scale"}`` sub-dicts in
+    place of float kernels (``ops/quant.quantize_tree_int8``). A
+    ``_q8`` leaf inherits its kernel's spec; a ``_scale`` leaf inherits
+    it too but its keepdims-1 dims (and any other non-dividing dim)
+    drop their axes via :func:`_fit_spec`, so scales end up replicated
+    unless their channel axis is genuinely sharded. Leaves with no
+    metadata (or paths the abstract tree lacks) replicate."""
+    from jax.sharding import NamedSharding
+    from jax.tree_util import tree_flatten_with_path, tree_map_with_path
+
+    logical = nn.get_partition_spec(abstract_params)
+    mesh_sh = logical_to_mesh_sharding(logical, mesh, list(rules))
+
+    def path_names(path):
+        return tuple(str(getattr(k, "key", k)) for k in path)
+
+    by_path = {path_names(p): sh
+               for p, sh in tree_flatten_with_path(mesh_sh)[0]}
+
+    def one(path, leaf):
+        names = path_names(path)
+        if names and names[-1] in ("_q8", "_scale"):
+            names = names[:-1]
+        sh = by_path.get(names)
+        spec = sh.spec if sh is not None else P()
+        shape = getattr(leaf, "shape", ())
+        return NamedSharding(mesh, _fit_spec(spec, shape, mesh))
+
+    return tree_map_with_path(one, params)
 
 
 def zero_update_spec(spec: Optional[P], shape, mesh: Mesh,

@@ -71,13 +71,30 @@ drive the pool with dummy payloads, no backend needed).
 from __future__ import annotations
 
 import heapq
+import math
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 __all__ = ["HostPageStore", "PagePool", "PagedKVCacheManager",
-           "SlotKVCacheManager", "scatter_slot"]
+           "SlotKVCacheManager", "leaf_device_nbytes", "scatter_slot"]
+
+
+def leaf_device_nbytes(leaf) -> int:
+    """PER-DEVICE bytes of one array: the addressable shard's size, not
+    the global one. Under a mesh-sharded serving engine the KV cache
+    leaves split their heads axis over ``mp``, so the bytes a device
+    actually holds — the number HBM capacity planning cares about — is
+    the shard, and on a single device the shard IS the array."""
+    shape = tuple(getattr(leaf, "shape", ()))
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None and hasattr(sharding, "shard_shape"):
+        try:
+            shape = sharding.shard_shape(shape)
+        except Exception:  # abstract/tracer leaves: fall back to global
+            pass
+    return int(math.prod(shape)) * np.dtype(leaf.dtype).itemsize
 
 
 class HostPageStore:
@@ -221,13 +238,14 @@ class _LaneBook:
         heapq.heappush(self._free, slot)
 
     def cache_nbytes(self) -> int:
-        """Device bytes of the live cache tree, measured from the actual
-        leaves (int8 values + fp32 scales when kv-quantized, full-width
-        K/V otherwise) — the scrapeable ground truth for the quantized
-        HBM story (``fleetx_serving_kv_cache_bytes``)."""
-        return sum(
-            int(leaf.size) * np.dtype(leaf.dtype).itemsize
-            for leaf in jax.tree.leaves(self.cache))
+        """PER-DEVICE bytes of the live cache tree, measured from the
+        actual leaves (int8 values + fp32 scales when kv-quantized,
+        full-width K/V otherwise; the addressable shard when the engine
+        sharded the heads over a mesh) — the scrapeable ground truth for
+        the quantized ~½× AND the mesh ÷mp HBM stories
+        (``fleetx_serving_kv_cache_bytes``)."""
+        return sum(leaf_device_nbytes(leaf)
+                   for leaf in jax.tree.leaves(self.cache))
 
 
 class SlotKVCacheManager(_LaneBook):

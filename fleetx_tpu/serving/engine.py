@@ -112,6 +112,31 @@ fault (per-request draft counters are snapshot-covered), and the
 proposer's lane state resets with recovery and rebuilds lazily from
 host truth.
 
+Mesh-sharded serving (``mesh=`` kwarg; docs/SERVING.md "Mesh-sharded
+serving"): the engine runs its device side over a TP/FSDP
+``jax.sharding.Mesh`` (arXiv 2105.04663 GSPMD / 2204.06514 pjit are the
+blueprint), so a model that does not fit — or does not hit latency
+targets — on one chip serves from a mesh. What shards: params (and
+quantized weight trees) get TP(mp)/FSDP shardings from the model's own
+logical-axis metadata via ``parallel/sharding.serving_param_shardings``,
+and BOTH cache layouts (slot and paged pools, int8 scale leaves
+included) split their heads axis over ``mp`` — per-device cache bytes
+and ``cache_nbytes()`` divide by the mp extent, which is the capacity
+math a router prices replicas with. What replicates: the decode-lane
+state dict, block tables, and every scalar. Every jitted device call
+(bucketed prefill, chunk prefill, decode tick, spec verify, probe,
+replay) runs under the mesh, and the flash-decode kernels run per-shard
+inside ``shard_map`` over the local head slice (the PR 1 "meshes →
+dense fallback" guard is lifted; ops/pallas/decode_attention.py), so
+the live-prefix HBM-traffic contract holds per device. Host bookkeeping
+— scheduler, lanes, trie, host spill tier, transactional snapshots,
+replay recovery — is pure-host and MESH-AGNOSTIC: ``recover()``
+rebuilds sharded device state from the same host truth, and greedy
+streams are byte-identical to the single-device engine (the per-head
+kernel math is unsharded math; the only reduction GSPMD splits is the
+row-parallel output projection). pp/cp extents and head counts the mp
+extent does not divide raise at construction.
+
 Unsupported request shapes (beam search, repetition penalty, forced
 EOS/BOS) raise at construction/submit — they need cross-step state the
 slot loop does not carry; use the one-shot ``generate()`` for those.
@@ -164,6 +189,7 @@ Crash safety (docs/RESILIENCE.md serving-recovery):
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import os
@@ -174,6 +200,7 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fleetx_tpu.obs import http as obs_http
 from fleetx_tpu.obs.events import emit as obs_emit
@@ -339,7 +366,8 @@ class ServingEngine:
                  host_cache_bytes: Optional[int] = None,
                  spec: Optional[bool] = None,
                  spec_k: Optional[int] = None,
-                 spec_proposer=None):
+                 spec_proposer=None,
+                 mesh=None):
         gen_cfg = gen_cfg or GenerationConfig(decode_strategy="greedy")
         if gen_cfg.repetition_penalty != 1.0:
             raise ValueError("continuous batching does not support "
@@ -348,6 +376,39 @@ class ServingEngine:
             raise ValueError("continuous batching does not support "
                              "forced_eos_token_id")
         self.gen_cfg = gen_cfg
+        # mesh-native serving (module docstring "Mesh-sharded serving"):
+        # params shard TP(mp)/FSDP, caches shard heads-over-mp, host
+        # bookkeeping stays mesh-agnostic. Validated up front — an
+        # unshardable config must fail here with a cause, not deep
+        # inside the first traced model.apply.
+        self.mesh = mesh
+        self._rules = None
+        if mesh is not None:
+            from fleetx_tpu.parallel.sharding import make_rules
+
+            shape = dict(mesh.shape)
+            if shape.get("pp", 1) > 1 or shape.get("cp", 1) > 1:
+                raise ValueError(
+                    f"serving mesh {shape} has pp/cp extents; the decode "
+                    "tick runs the full layer stack per device — use a "
+                    "(dp, fsdp, mp) mesh")
+            if model.cfg.num_attention_heads % shape.get("mp", 1):
+                raise ValueError(
+                    f"num_attention_heads {model.cfg.num_attention_heads} "
+                    f"does not divide over mp={shape.get('mp', 1)}; the "
+                    "kv cache shards over heads (module docstring)")
+            if shape.get("dp", 1) > 1:
+                # the engine shards nothing over dp (mp splits heads,
+                # fsdp splits params): a dp extent just replicates the
+                # decode tick on every dp device. Allowed — one engine
+                # can own a predict()-shaped mesh — but the hardware
+                # would serve more traffic as dp separate REPLICAS.
+                logger.warning(
+                    "serving: mesh has dp=%d — the decode tick is "
+                    "REPLICATED over the dp axis (no throughput gain); "
+                    "prefer %d independent engine replicas behind a "
+                    "router", shape["dp"], shape["dp"])
+            self._rules = make_rules(fsdp_params=shape.get("fsdp", 1) > 1)
         self.slots = slots or _env_int("FLEETX_SERVING_SLOTS", 8)
         self.paged = (paged if paged is not None
                       else _env_int("FLEETX_SERVING_PAGED", 1) == 1)
@@ -409,6 +470,11 @@ class ServingEngine:
         from fleetx_tpu.ops.quant import serving_weight_params
 
         self.params = serving_weight_params(self.params, self.weight_dtype)
+        if self.mesh is not None:
+            # TP(mp)/FSDP-shard the (possibly quantized) servable tree:
+            # committed NamedSharding inputs drive GSPMD inside every jit
+            # from here on, no per-call annotations needed
+            self.params = self._shard_params(self.params)
         self.topk_cap = topk_cap or _env_int("FLEETX_SERVING_TOPK_CAP", 64)
         self.prefill_bucket = (prefill_bucket
                                or _env_int("FLEETX_SERVING_PREFILL_BUCKET", 32))
@@ -471,6 +537,9 @@ class ServingEngine:
         else:
             self.cache_manager = SlotKVCacheManager(self.model, self.slots,
                                                     cache_len)
+        # mesh: the freshly-built cache tree splits its heads over mp
+        # (scale leaves ride the same rule); state/tables replicate
+        self.cache_manager.cache = self._shard_cache(self.cache_manager.cache)
         self._tables_dev = None       # device mirror of the block tables,
         self._tables_version = -1     # refreshed when the manager's moves
         self.scheduler = FIFOScheduler()
@@ -484,7 +553,7 @@ class ServingEngine:
         # one by policy — the FIFO head — a dict for snapshot symmetry)
         self._prefilling: Dict[int, Request] = {}
         self._results: Dict[int, ServingResult] = {}
-        self._state = self._init_state()
+        self._state = self._replicate(self._init_state())
         # buffer donation halves cache HBM residency on TPU; skipped on
         # CPU/interpret runs where XLA would only warn about it
         donate = jax.default_backend() in ("tpu", "axon")
@@ -500,9 +569,14 @@ class ServingEngine:
         self._admit_jit = jax.jit(self._admit_fn, donate_argnums=())
         self._deactivate_jit = jax.jit(_deactivate)
         # chunked slot prefill: fold the finished batch-1 working cache
-        # into the big slot cache (both operands are dead afterwards)
+        # into the big slot cache (both operands are dead afterwards);
+        # the pin keeps the folded cache on its mesh layout
+
+        def _scatter_pinned(cache, small, slot):
+            return self._pin_cache(scatter_slot(cache, small, slot))
+
         self._scatter_jit = jax.jit(
-            scatter_slot, donate_argnums=(0, 1) if donate else ())
+            _scatter_pinned, donate_argnums=(0, 1) if donate else ())
         self._prefill_jits = {}  # (kind, bucket_len) -> jitted prefill
         self._donate_cache = donate
         # speculative decoding (module docstring): default OFF — a spec-
@@ -941,7 +1015,7 @@ class ServingEngine:
             self._prefilling = {}
             self._tables_dev = None
             self._tables_version = -1
-            self._state = self._init_state()
+            self._state = self._replicate(self._init_state())
             if self.paged:
                 # the HOST spill tier survives the rebuild: its entries
                 # are keyed by token-chunk path, not trie-node identity,
@@ -954,6 +1028,10 @@ class ServingEngine:
             else:
                 self.cache_manager = SlotKVCacheManager(
                     self.model, self.slots, self.cache_len)
+            # the rebuilt device cache re-commits onto the SAME mesh
+            # layout — host truth is mesh-agnostic, the layout is not
+            self.cache_manager.cache = self._shard_cache(
+                self.cache_manager.cache)
             if self._proposer is not None:
                 # draft-lane state is device-adjacent: drop it and let
                 # the next propose() rebuild lazily from host truth
@@ -1100,6 +1178,16 @@ class ServingEngine:
         abandoned call's thread is orphaned (a truly hung XLA call cannot
         be interrupted from Python) and its buffers are never reused —
         recovery rebuilds fresh ones."""
+        if self.mesh is not None:
+            # trace-time mesh context (flash dispatch + logical rules);
+            # entered INSIDE the callable so the watchdog's worker thread
+            # sees it too (contexts do not cross executor threads)
+            inner = fn
+
+            def fn():
+                with self._mesh_context():
+                    return inner()
+
         if not self.tick_timeout_s or self.tick_timeout_s <= 0:
             return fn()
         import concurrent.futures
@@ -1327,19 +1415,115 @@ class ServingEngine:
 
         return dequantize_tree_int8(params, dtype=jnp.float32)
 
+    # -------------------------------------------------- mesh sharding seams
+
+    def _mesh_context(self):
+        """Trace-time context for meshed device calls: the framework mesh
+        registry (so the model's flash-decode dispatch sees the ambient
+        mesh and shard_maps the kernels) plus the logical-axis rules (so
+        activation constraints resolve). A no-op context unmeshed, and
+        free after the first trace per call shape — jit caches skip it."""
+        if self.mesh is None:
+            return contextlib.nullcontext()
+        from flax import linen as nn
+
+        from fleetx_tpu.parallel.mesh import use_mesh
+
+        ctx = contextlib.ExitStack()
+        ctx.enter_context(use_mesh(self.mesh))
+        ctx.enter_context(nn.logical_axis_rules(list(self._rules)))
+        return ctx
+
+    def _shard_params(self, params):
+        """device_put the servable tree onto its TP(mp)/FSDP layout. The
+        model's own ``nn.Partitioned`` metadata (recovered via an
+        eval_shape init) names each param's logical axes; quantized
+        ``{"_q8", "_scale"}`` leaves inherit their kernel's spec with
+        non-dividing dims dropped (parallel/sharding.py). Boxed trees
+        are unboxed first — the committed NamedShardings carry the
+        layout from here on."""
+        from flax import linen as nn
+
+        from fleetx_tpu.parallel.sharding import serving_param_shardings
+
+        params = jax.tree.map(
+            lambda x: x.unbox() if isinstance(x, nn.Partitioned) else x,
+            params, is_leaf=lambda x: isinstance(x, nn.Partitioned))
+        abstract = jax.eval_shape(lambda: self.model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32)))["params"]
+        shardings = serving_param_shardings(abstract, params, self.mesh,
+                                            self._rules)
+        return jax.tree.map(jax.device_put, params, shardings)
+
+    def _cache_shardings(self, cache):
+        """Heads-over-mp NamedShardings for a decode cache tree: every
+        rank-≥4 leaf (K/V slots or pages AND their int8 scale leaves —
+        all carry heads at axis -2) splits on ``mp``; scalars replicate.
+        Head divisibility was validated at construction."""
+        mp = dict(self.mesh.shape).get("mp", 1)
+
+        def one(leaf):
+            if getattr(leaf, "ndim", 0) >= 4 and mp > 1:
+                spec = [None] * leaf.ndim
+                spec[leaf.ndim - 2] = "mp"
+                return NamedSharding(self.mesh, P(*spec))
+            return NamedSharding(self.mesh, P())
+
+        return jax.tree.map(one, cache)
+
+    def _shard_cache(self, cache):
+        """Commit a host/eagerly-built cache tree onto the mesh layout
+        (construction, recovery, chunk working caches); identity
+        unmeshed."""
+        if self.mesh is None:
+            return cache
+        return jax.tree.map(jax.device_put, cache,
+                            self._cache_shardings(cache))
+
+    def _pin_cache(self, cache):
+        """In-jit sharding constraint pinning a returned cache tree to
+        the heads-over-mp layout, so no device call can drift the cache
+        into a gathered/replicated layout between ticks (and donation
+        keeps matching buffer for buffer); identity unmeshed."""
+        if self.mesh is None:
+            return cache
+        return jax.lax.with_sharding_constraint(
+            cache, self._cache_shardings(cache))
+
+    def _replicate(self, tree):
+        """Commit small host-built device state (lane scalars, block
+        tables) as mesh-replicated; identity unmeshed."""
+        if self.mesh is None:
+            return tree
+        sh = NamedSharding(self.mesh, P())
+        return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
     def _publish_quant_metrics(self) -> None:
-        """Push the precision config + bytes gauges into the metrics
-        facade (labels kv_dtype/weight_dtype; docs/OBSERVABILITY.md).
+        """Push the precision + mesh config and bytes gauges into the
+        metrics facade (labels kv_dtype/weight_dtype/mesh;
+        docs/OBSERVABILITY.md). All byte gauges are PER DEVICE: under a
+        mesh the cache splits its heads over mp and the params split
+        TP/FSDP, so what one device holds is the capacity number.
         Re-call after swapping ``engine.metrics`` (the bench does)."""
+        from fleetx_tpu.serving.cache_manager import leaf_device_nbytes
+
         cfg = self.model.cfg
+        mp = 1 if self.mesh is None else dict(self.mesh.shape).get("mp", 1)
         kv_item = 1 if self.kv_dtype == "int8" else jnp.dtype(cfg.dtype).itemsize
-        # K + V bytes one cached token costs across every layer, scales
-        # included (one fp32 scale per head vector at int8)
-        kv_bytes = cfg.num_layers * cfg.num_attention_heads * 2 * (
+        # K + V bytes one cached token costs across every layer ON ONE
+        # DEVICE, scales included (one fp32 scale per head vector at
+        # int8); heads divide over mp under a mesh
+        kv_bytes = cfg.num_layers * (cfg.num_attention_heads // mp) * 2 * (
             cfg.head_dim * kv_item + (4 if self.kv_dtype == "int8" else 0))
         weight_bytes = sum(
-            int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+            leaf_device_nbytes(leaf)
             for leaf in jax.tree.leaves(self.params))
+        if self.mesh is None:
+            self.metrics.set_mesh(1, "-")
+        else:
+            desc = "x".join(f"{k}{v}" for k, v in self.mesh.shape.items()
+                            if v > 1) or "1"
+            self.metrics.set_mesh(self.mesh.size, desc)
         self.metrics.set_quant_config(
             self.kv_dtype, self.weight_dtype, kv_bytes, weight_bytes,
             kv_cache_bytes=self.cache_manager.cache_nbytes())
@@ -1382,7 +1566,8 @@ class ServingEngine:
             return None
         version = self.cache_manager.tables_version
         if version != self._tables_version:
-            self._tables_dev = jnp.asarray(self.cache_manager.tables)
+            self._tables_dev = self._replicate(
+                jnp.asarray(self.cache_manager.tables))
             self._tables_version = version
         return self._tables_dev
 
@@ -1403,7 +1588,7 @@ class ServingEngine:
                               max_pos - 1)[None, :]
             logits, small = decode_step(
                 self.model, params, init_decode_cache(self.model, 1), ids, pos)
-            cache = scatter_slot(cache, small, slot)
+            cache = self._pin_cache(scatter_slot(cache, small, slot))
             last = jax.lax.dynamic_slice_in_dim(
                 logits[0], true_len - 1, 1, axis=0).astype(jnp.float32)
             vocab = last.shape[-1]
@@ -1439,6 +1624,7 @@ class ServingEngine:
             logits, cache = decode_step(
                 self.model, params, cache, ids, pos,
                 cache_positions=wpos[None], block_tables=table[None])
+            cache = self._pin_cache(cache)
             last = jax.lax.dynamic_slice_in_dim(
                 logits[0], true_len - 1, 1, axis=0).astype(jnp.float32)
             vocab = last.shape[-1]
@@ -1485,7 +1671,8 @@ class ServingEngine:
         self._fault_prefills += 1
         with span("serving.prefill", request=req.id, bucket=bucket):
             faults.on_serving_prefill(attempt, req.id)
-            cache, tok = fn(*args)
+            with self._mesh_context():
+                cache, tok = fn(*args)
         if chunk_cache:
             req.chunk_cache = cache
         else:
@@ -1569,6 +1756,7 @@ class ServingEngine:
             logits, cache = decode_step(
                 self.model, params, cache, ids, pos,
                 cache_positions=wpos[None])
+            cache = self._pin_cache(cache)
             last = jax.lax.dynamic_slice_in_dim(
                 logits[0], true_len - 1, 1, axis=0).astype(jnp.float32)
             vocab = last.shape[-1]
@@ -1666,7 +1854,8 @@ class ServingEngine:
                 req.prefill_pos = shared
                 req.phase = "prefilling"
                 if not self.paged:
-                    req.chunk_cache = init_decode_cache(self.model, 1)
+                    req.chunk_cache = self._shard_cache(
+                        init_decode_cache(self.model, 1))
                 self._prefilling[req.slot] = req
                 req.admit_time = self._now()
                 self.metrics.record_admit(req.admit_time - req.submit_time)
@@ -1816,7 +2005,7 @@ class ServingEngine:
         new_st["decoded"] = jnp.where(active, decoded, st["decoded"])
         new_st["active"] = active & ~done
         new_st["rng"] = new_rng
-        return cache, new_st, tok, done
+        return self._pin_cache(cache), new_st, tok, done
 
     def _tick_decode(self):
         retired = []
@@ -2034,7 +2223,7 @@ class ServingEngine:
         new_st["decoded"] = jnp.where(active, decoded, st["decoded"])
         new_st["active"] = active & ~done
         new_st["rng"] = new_rng
-        return cache, new_st, out, m, acc, done
+        return self._pin_cache(cache), new_st, out, m, acc, done
 
     def _tick_decode_spec(self):
         """Speculative sibling of :meth:`_tick_decode`: clamp k, grow
@@ -2097,7 +2286,11 @@ class ServingEngine:
             for slot, req in self._active.items()
         }
         with span("serving.draft", batch=len(req_map), k=k):
-            proposals = self._proposer.propose(req_map, k)
+            # mesh context covers draft-model proposers (their device
+            # calls run the same sharded params); the n-gram proposer is
+            # pure host and the context is a no-op around it
+            with self._mesh_context():
+                proposals = self._proposer.propose(req_map, k)
         draft = np.zeros((self.slots, k), np.int32)
         dlen = np.zeros(self.slots, np.int32)
         for slot, (_, cap) in req_map.items():

@@ -179,13 +179,24 @@ class ServingMetrics:
         self._g_host_pages = gauge(
             "fleetx_serving_host_cache_pages",
             "Spilled KV pages resident in the host-DRAM tier")
+        # mesh-sharded serving (docs/SERVING.md "Mesh-sharded serving"):
+        # how many devices this engine's decode tick spans — the router
+        # reads it to price a replica's capacity (1 = unmeshed)
+        self._g_mesh_devices = gauge(
+            "fleetx_serving_mesh_devices",
+            "Devices the engine's jitted decode tick runs across "
+            "(1 = single-device engine)")
+        self._g_mesh_devices.set(1)
+        self.mesh_desc = "-"
         # quantized-serving config (docs/QUANTIZATION.md): the info-style
-        # family carries the active precision pair as labels; the bytes
-        # gauges make the HBM win scrapeable next to tokens/s
+        # family carries the active precision pair as labels — plus the
+        # mesh shape, so one scrape says what precision runs on what
+        # device slice; the bytes gauges make the HBM win scrapeable
+        # next to tokens/s
         self._quant_family = reg.gauge(
             "fleetx_serving_quant_config",
-            "1 at the engine's active (kv_dtype, weight_dtype) pair",
-            ("engine", "kv_dtype", "weight_dtype"))
+            "1 at the engine's active (kv_dtype, weight_dtype, mesh) tuple",
+            ("engine", "kv_dtype", "weight_dtype", "mesh"))
         self._g_kv_bytes = gauge(
             "fleetx_serving_kv_bytes_per_token",
             "KV-cache bytes one cached token costs across all layers "
@@ -291,17 +302,27 @@ class ServingMetrics:
         self._c_prompt_tokens.inc(int(prompt_tokens))
         self._h_pages_per_req.observe(int(pages))
 
+    def set_mesh(self, devices: int, desc: str = "-") -> None:
+        """Publish the engine's mesh footprint: ``devices`` the decode
+        tick spans (1 = unmeshed) and a short shape string (e.g.
+        ``"mp2"``, ``"fsdp2xmp2"``; ``"-"`` unmeshed) that also labels
+        the quant-config info gauge."""
+        self.mesh_desc = desc
+        self._g_mesh_devices.set(int(devices))
+
     def set_quant_config(self, kv_dtype: str, weight_dtype: str,
                          kv_bytes_per_token: int, weight_bytes: int,
                          kv_cache_bytes: int = 0) -> None:
         """Publish the engine's precision config: the (kv_dtype,
-        weight_dtype) info labels plus the bytes-per-token / param-bytes /
-        cache-tree gauges the HBM story is read from
-        (docs/QUANTIZATION.md)."""
+        weight_dtype, mesh) info labels plus the bytes-per-token /
+        param-bytes / cache-tree gauges the HBM story is read from
+        (docs/QUANTIZATION.md; bytes are PER DEVICE under a mesh —
+        docs/SERVING.md "Mesh-sharded serving"). Call :meth:`set_mesh`
+        first on a meshed engine so the label is current."""
         self.kv_dtype = kv_dtype
         self.weight_dtype = weight_dtype
         labels = {"engine": self.engine_label, "kv_dtype": kv_dtype,
-                  "weight_dtype": weight_dtype}
+                  "weight_dtype": weight_dtype, "mesh": self.mesh_desc}
         self._owned.append((self._quant_family, dict(labels)))
         self._quant_family.labels(**labels).set(1)
         self._g_kv_bytes.set(int(kv_bytes_per_token))
@@ -620,6 +641,11 @@ class ServingMetrics:
             "kv_bytes_per_token": int(self._g_kv_bytes.value),
             "weight_bytes": int(self._g_weight_bytes.value),
             "kv_cache_bytes": int(self._g_kv_cache_bytes.value),
+            # mesh story (docs/SERVING.md "Mesh-sharded serving"): how
+            # many devices the decode tick spans; the bytes gauges above
+            # are PER DEVICE, so they shrink as the mesh grows
+            "mesh_devices": int(self._g_mesh_devices.value),
+            "mesh": self.mesh_desc,
             # speculative-decoding story (docs/SERVING.md): what the
             # proposer offered, what verification kept, and the
             # resulting decode multiplier (1.0 mean = nothing accepted)

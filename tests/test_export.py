@@ -152,7 +152,13 @@ def test_engine_generate_delegates_to_serving(gen_engine_factory, monkeypatch):
 
 def test_engine_generate_mesh_sharded(gen_engine_factory, eight_devices):
     """generate() must honor self.mesh like predict() does (the old code
-    ran unsharded): same greedy tokens, sharded over a dp x mp mesh."""
+    ran unsharded): same greedy tokens, sharded over a dp x mp mesh.
+    Since the mesh-native serving engine (ISSUE 14) a servable TP/FSDP
+    mesh call DELEGATES to continuous batching like the unmeshed path —
+    asserted on an mp2 mesh, so the old mesh-bails-to-one-shot special
+    case cannot regress back — while a dp>1 mesh deliberately KEEPS the
+    one-shot path (its batch genuinely dp-shards there; the serving tick
+    would only replicate over dp)."""
     from fleetx_tpu.parallel.mesh import MeshConfig, build_mesh
 
     plain = np.asarray(gen_engine_factory().generate(
@@ -165,6 +171,17 @@ def test_engine_generate_mesh_sharded(gen_engine_factory, eight_devices):
         np.asarray([[5, 6, 7], [11, 3, 8]], np.int32), max_length=5,
         decode_strategy="greedy"))
     np.testing.assert_array_equal(out, plain)
+    assert engine._serving is None  # dp mesh: one-shot path, by design
+
+    mp2 = build_mesh(MeshConfig(mp=2), eight_devices[:2])
+    engine = gen_engine_factory(mesh=mp2)
+    out = np.asarray(engine.generate(
+        np.asarray([[5, 6, 7], [11, 3, 8]], np.int32), max_length=5,
+        decode_strategy="greedy"))
+    np.testing.assert_array_equal(out, plain)
+    assert engine._serving is not None, (
+        "mp2 generate() did not delegate to the serving engine")
+    assert engine._serving.mesh is mp2  # the delegate engine IS meshed
 
 
 def test_engine_small_serving_cache_falls_back_one_shot(gen_engine_factory,

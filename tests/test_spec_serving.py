@@ -170,7 +170,11 @@ def test_spec_draft_clamped_to_budget_and_eos(model_and_params, prompts):
     assert int(b.tokens[-1]) == eos and eos not in b.tokens[:-1]
 
 
+@pytest.mark.slow  # 15.6s baseline (PR 14 tier-1 budget audit): the
 def test_spec_cache_capacity_edge(model_and_params):
+    # capacity-clamp contract stays tier-1 via
+    # test_spec_near_dry_pool_matches_plain (cache_full determinism
+    # under a dry pool) + the spec greedy parity gates
     """The ISSUE small-fix regression (mirroring the PR 11 chunk-edge
     fix): a request decoding right up to cache capacity under a large k
     must neither overrun its lane/pages mid-verify nor change a byte —
@@ -236,7 +240,11 @@ def test_spec_proposer_kwarg_implies_spec(model_and_params):
         _engine(model, params, spec=False, spec_proposer=NgramProposer())
 
 
+@pytest.mark.slow  # 15.5s baseline (PR 14 tier-1 budget audit): the
 def test_spec_acceptance_on_repetitive_prompt(model_and_params):
+    # acceptance contract stays tier-1 via the bench spec record's
+    # schema test (tokens_per_tick_mean > 1 and acceptance_rate > 0
+    # asserted on the same repetitive-workload shape)
     """Acceptance-rate sanity: on a motif-repeating prompt with a long
     EOS-free decode, the n-gram proposer must accept far more than
     nothing — the whole point of prompt-lookup drafting."""
@@ -309,7 +317,11 @@ def test_ngram_proposer_matching():
         NgramProposer(max_n=2, min_n=3)
 
 
+@pytest.mark.slow  # 7.2s baseline (PR 14 tier-1 budget audit): the
 def test_draft_model_proposer_lane_lifecycle(model_and_params):
+    # self-draft proposer's end-to-end contract stays covered by the
+    # slow matrix (slot+paged x ngram+self-draft parity); the n-gram
+    # proposer units above remain tier-1
     """The draft proposer's lane protocol without an engine: catch-up
     prefill on first propose, drafts equal the model's own greedy
     continuation (self-draft -> perfect prediction), observe() rewinds
@@ -380,7 +392,11 @@ def test_spec_self_draft_acceptance_one(model_and_params, prompts,
 
 # ------------------------------------------------------------ sampling path
 
+@pytest.mark.slow  # 21.7s baseline (PR 14 tier-1 budget audit): the
 def test_spec_sampling_topk1_byte_parity(model_and_params, prompts):
+    # sampling-rejection path stays gated by the slow-tier fixed-seed
+    # total-variation distribution test; greedy byte parity (the
+    # deterministic contract) stays tier-1 via test_spec_greedy_byte_parity
     """top_k=1 sampling is a degenerate distribution: the speculative
     REJECTION path must reproduce it byte-exactly (accept prob 1 on the
     matching draft, residual never sampled) — gated through the shared

@@ -87,14 +87,23 @@ def test_bench_serving_records_schema(monkeypatch):
     import tools.bench_serving as bs
 
     bs = importlib.reload(bs)  # re-read the _TINY env gate
+    import jax
+
     recs = bs.serving_records(n_requests=6, slots=2)
-    assert [r["metric"] for r in recs] == [
-        "gpt_345m_serving_static", "gpt_345m_serving_continuous",
-        "gpt_345m_serving_shared_prefix", "gpt_345m_serving_faulted",
-        "gpt_345m_serving_int8", "gpt_345m_serving_chunked",
-        "gpt_345m_serving_spec", "gpt_345m_serving_page_sweep",
-    ]
-    static, cont, shared, faulted, int8, chunked, spec, sweep = recs
+    # the mesh record degrades gracefully below 2 devices (the
+    # FLEETX_TEST_PLATFORM=real single-chip certification run)
+    has_mesh = jax.device_count() >= 2
+    want = ["gpt_345m_serving_static", "gpt_345m_serving_continuous",
+            "gpt_345m_serving_shared_prefix", "gpt_345m_serving_faulted",
+            "gpt_345m_serving_int8", "gpt_345m_serving_chunked",
+            "gpt_345m_serving_spec"]
+    if has_mesh:
+        want.append("gpt_345m_serving_mesh")
+    want.append("gpt_345m_serving_page_sweep")
+    assert [r["metric"] for r in recs] == want
+    static, cont, shared, faulted, int8, chunked, spec = recs[:7]
+    mesh = recs[7] if has_mesh else None
+    sweep = recs[-1]
     for r in recs:
         assert r["unit"] == "tokens/s"
         assert np.isfinite(r["value"]) and r["value"] > 0
@@ -179,6 +188,16 @@ def test_bench_serving_records_schema(monkeypatch):
     assert [s["k"] for s in d["k_sweep"]] == [2, 4, 8]
     for s in d["k_sweep"]:
         assert s["tokens_per_s"] > 0 and s["tokens_per_tick_mean"] > 1
+    # the mesh record: byte parity vs the single-device engine, the mp2
+    # shape reported, and PER-DEVICE cache bytes ~half the single-device
+    # engine's (the heads-over-mp shard is real)
+    if mesh is not None:
+        d = mesh["detail"]
+        assert d["parity"] is True
+        assert d["mesh"] == {"mp": 2} and d["mesh_devices"] == 2
+        assert (0 < d["kv_cache_bytes_per_device"]
+                < 0.6 * d["kv_cache_bytes_single_device"])
+        assert d["speedup_vs_single_device"] > 0
     # the page sweep ran its swept size byte-identically and picked it
     # (one size in the smoke — the tier-1 budget pays per swept size;
     # the multi-size comparison is the TPU window's job)
@@ -307,6 +326,22 @@ def test_chaos_check_serving_recovery_scenarios(tmp_path, capsys):
     assert rc == 0, out
     for name in names.split(","):
         assert f"PASS {name}" in out
+
+
+@pytest.mark.slow  # ~15s; tier-1 covers the same contracts via
+def test_chaos_check_serving_mesh_scenario(tmp_path, capsys):
+    # tests/test_mesh_serving.py (mp2 parity + sharded recover); this
+    # proves the CLI scenario end-to-end
+    """The mesh-sharded serving chaos scenario (tick fault + recover()
+    on an mp2 engine, byte parity vs clean, per-device cache bytes stay
+    halved, engine_recovery event) passes through the CLI driver."""
+    sys.path.insert(0, REPO)
+    import tools.chaos_check as cc
+
+    rc = cc.main(["--only", "serving_mesh", "--workdir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "PASS serving_mesh" in out
 
 
 @pytest.mark.slow  # ~10s; tier-1 covers the same contracts via

@@ -64,6 +64,14 @@ baseline, acceptance rate, and baseline-vs-spec TTFT reported, plus a
 `detail.k_sweep` over `FLEETX_SERVING_SPEC_K` ∈ {2, 4, 8} (each swept k
 byte-identical too).
 
+An eighth record (`mesh`) prices MESH-SHARDED SERVING (docs/SERVING.md
+"Mesh-sharded serving"): the same continuous workload through an engine
+whose params and KV cache shard over a TP(mp2) mesh — byte parity vs the
+single-device run ASSERTED, per-device `fleetx_serving_kv_cache_bytes`
+(~half the single-device engine's), tokens/s, TTFT, and the mesh shape
+in `detail.mesh`. Skipped (no record) below 2 devices or when the heads
+don't divide.
+
 `BENCH_SERVING_PAGE_SIZES=16,32,64` appends a page-size sweep record
 (`page_sweep`): the continuous workload re-run per page size so a TPU
 window can pick a DMA-tuned default over the correctness-tuned 16
@@ -657,6 +665,47 @@ def serving_records(n_requests: int = N_REQUESTS, slots: int = SLOTS):
         f"the draft path gained nothing ({spec_detail})")
     spec_detail["k_sweep"] = k_sweep
 
+    # mesh mode (docs/SERVING.md "Mesh-sharded serving"): the continuous
+    # workload on a TP(mp2) mesh — byte parity vs single-device asserted,
+    # per-device KV bytes ~halve; skipped below 2 devices (the record is
+    # the point where a model outgrowing one chip keeps serving)
+    mesh_detail = None
+    n_heads = model.cfg.num_attention_heads
+    if jax.device_count() >= 2 and n_heads % 2 == 0:
+        from fleetx_tpu.parallel.mesh import MeshConfig, build_mesh
+
+        mesh = build_mesh(MeshConfig(mp=2), jax.devices()[:2])
+        mesh_engine = ServingEngine(model, variables, slots=slots,
+                                    cache_len=model.cfg.max_position_embeddings,
+                                    gen_cfg=gen_cfg,
+                                    prefill_bucket=8 if _TINY else 32,
+                                    mesh=mesh)
+        if not _TINY:
+            _run_continuous(mesh_engine, workload)  # compile warmup
+        mesh_toks, _, mesh_detail = _run_continuous(mesh_engine, workload)
+        # sharding is a layout, never math: not one byte may move
+        mesh_detail["parity"] = all(
+            np.array_equal(a, b) for a, b in zip(cont_toks, mesh_toks))
+        assert mesh_detail["parity"], (
+            "mesh-sharded serving broke greedy byte parity vs the "
+            "single-device engine")
+        snap = mesh_engine.metrics.snapshot()
+        single_snap = cont_detail["obs_snapshot"]
+        mesh_detail.update({
+            "mesh": {a: int(s) for a, s in mesh.shape.items() if s > 1}
+                    or {"mp": 1},
+            "mesh_devices": snap["mesh_devices"],
+            # PER-DEVICE cache bytes: the capacity math that lets a
+            # model too big (or too slow) for one chip serve from a mesh
+            "kv_cache_bytes_per_device": snap["kv_cache_bytes"],
+            "kv_cache_bytes_single_device": single_snap["kv_cache_bytes"],
+            "weight_bytes_per_device": snap["weight_bytes"],
+            "weight_bytes_single_device": single_snap["weight_bytes"],
+        })
+        mesh_tps = mesh_detail["useful_tokens"] / mesh_detail["elapsed_s"]
+        mesh_detail["speedup_vs_single_device"] = round(
+            mesh_tps / clean_tps, 3)
+
     # shared-prefix mode: paged engine, trie-cold warmup then warm timing
     sp_workload = _shared_prefix_workload(n_requests)
     sp_engine = ServingEngine(model, variables, slots=slots,
@@ -682,6 +731,8 @@ def serving_records(n_requests: int = N_REQUESTS, slots: int = SLOTS):
              ("int8", int8_detail),
              ("chunked", ck_detail),
              ("spec", spec_detail)]
+    if mesh_detail is not None:
+        modes.append(("mesh", mesh_detail))
 
     # page-size sweep (ROADMAP item 1 follow-up): opt-in via
     # BENCH_SERVING_PAGE_SIZES so a TPU window can pick a DMA-tuned

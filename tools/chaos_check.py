@@ -32,6 +32,12 @@ per scenario, non-zero exit on any failure:
   streams stay byte-identical to BOTH a clean speculative run and the
   non-speculative engine (tick_fault / engine_recovery / spec_enabled
   events asserted);
+- ``serving_mesh``: a decode-tick fault on a MESH-SHARDED engine
+  (``mesh=mp2`` — params TP-sharded, KV cache heads split over mp):
+  rollback + ``recover()`` rebuild the SHARDED device state from host
+  truth, streams stay byte-identical to a clean single-device engine,
+  per-device cache bytes stay halved, and the ``engine_recovery`` event
+  is banked (skips gracefully below 2 devices);
 - ``serving_spill``: the two-level page cache under a mid-chunk fault —
   a warm prefix spills to the host-DRAM tier under pool pressure, a
   chunked-prefill request reviving it is killed mid-chunk, the tick
@@ -550,6 +556,54 @@ def scenario_serving_spec(tmp):
             "events banked)")
 
 
+def scenario_serving_mesh(tmp):
+    """Tick fault + recover() on an mp2-sharded engine: byte parity vs a
+    clean single-device run, sharded rebuild, events banked."""
+    import jax
+    import numpy as np
+
+    from fleetx_tpu.resilience.faults import faults
+
+    if jax.device_count() < 2:
+        return ("skipped: needs >=2 devices for an mp mesh (run with "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    from fleetx_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    make, prompts = _serving_fixture()
+    mesh = build_mesh(MeshConfig(mp=2), jax.devices()[:2])
+    single = make(True)
+    clean, _, _ = _run_workload(single, prompts)
+    meshed, _, _ = _run_workload(make(True, mesh=mesh), prompts)
+    assert all(np.array_equal(a, b) for a, b in zip(clean, meshed)), \
+        "mesh-sharded engine diverged from the single-device engine"
+    faults.configure(tick_raise="1")
+    try:
+        eng = make(True, mesh=mesh)
+        faulty, _, _ = _run_workload(eng, prompts)
+    finally:
+        faults.reset()
+    assert eng.metrics.engine_recoveries == 1, eng.metrics.snapshot()
+    assert all(np.array_equal(a, b) for a, b in zip(clean, faulty)), \
+        "tokens diverged after a fault + recovery on the mesh"
+    eng.cache_manager.pool.check_invariants()
+    # the REBUILT cache kept its per-device shard (heads / mp)
+    single_bytes = single.cache_manager.cache_nbytes()
+    mesh_bytes = eng.cache_manager.cache_nbytes()
+    assert mesh_bytes < 0.55 * single_bytes, (
+        f"recovered cache is {mesh_bytes}B/device vs {single_bytes}B "
+        "single-device — the rebuild lost the mp shard")
+    from fleetx_tpu.obs import get_event_log
+
+    ev = get_event_log()
+    assert ev.find("tick_fault"), "the injected fault was not banked"
+    assert ev.find("engine_recovery"), "recovery left no structured event"
+    snap = eng.metrics.snapshot()
+    assert snap["mesh_devices"] == 2, snap
+    return ("mp2 engine recovered byte-identically "
+            f"(per-device cache {mesh_bytes}B vs {single_bytes}B "
+            "single-device; engine_recovery event banked)")
+
+
 def scenario_serving_spill(tmp):
     """Mid-chunk fault over the two-level page cache: rollback +
     requeue, host tier survives, revived pages reused, byte parity."""
@@ -642,6 +696,7 @@ SCENARIOS = {
     "serving_hang": scenario_serving_hang,
     "serving_drain": scenario_serving_drain,
     "serving_spec": scenario_serving_spec,
+    "serving_mesh": scenario_serving_mesh,
     "serving_spill": scenario_serving_spill,
 }
 
