@@ -32,7 +32,9 @@ from fleetx_tpu.obs.events import Event, EventLog, emit, get_event_log
 from fleetx_tpu.obs.http import (
     ObsServer,
     get_server,
+    health_report,
     health_status,
+    healthz_payload,
     maybe_start_from_env,
     register_health,
     unregister_health,
@@ -61,7 +63,9 @@ __all__ = [
     "get_recorder",
     "get_registry",
     "get_server",
+    "health_report",
     "health_status",
+    "healthz_payload",
     "maybe_start_from_env",
     "register_health",
     "span",

@@ -17,6 +17,8 @@ Known kinds (docs/OBSERVABILITY.md has the full table + attrs):
   ``tick_fault``, ``tick_timeout``, ``queue_reject``, ``drain_reject``,
   ``request_timeout``, ``request_cancelled``, ``callback_error``,
   ``shutdown``
+- router: ``replica_out``, ``replica_back``, ``replica_dead``,
+  ``request_migrated``, ``router_stranded``
 - chaos: ``fault_injected``
 
 The set is open — any snake_case kind is accepted — but new kinds

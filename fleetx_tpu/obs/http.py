@@ -8,12 +8,19 @@ the endpoint contract docs/OBSERVABILITY.md pins down:
   health status + span-ring stats (full spans via ``/trace``).
 - ``GET /trace``    — Chrome-trace JSON of the host span ring buffer
   (load in chrome://tracing / Perfetto).
-- ``GET /healthz``  — 200 ``{"status": "ok"}`` while every registered
-  health probe passes, 503 ``{"status": "unhealthy", "failing": [...]}``
-  otherwise. The serving engine registers a drain-aware probe, so
-  ``request_shutdown()`` (SIGTERM) flips a replica to 503 *while it
-  drains* — exactly the rotate-me-out signal the multi-replica router
-  (ROADMAP item 3) load-balances on.
+- ``GET /healthz``  — 200 while every registered health probe passes,
+  503 otherwise, with a small JSON body carrying the rotate-out REASON,
+  not just the code: ``state`` (``ok`` / ``draining`` / ``dead``, the
+  worst across probes), ``queue_depth`` and ``active`` (summed over
+  probes that report them), plus per-probe booleans, the failing names,
+  and each probe's full report under ``detail``. Probes may return a
+  plain bool (healthy yes/no) or a dict with a ``state`` key — the
+  serving engine returns its drain-aware ``ServingEngine.health()``
+  dict, so ``request_shutdown()`` (SIGTERM) flips a replica to 503
+  ``state: "draining"`` *while it drains* and ``RecoveryExhausted`` to
+  ``state: "dead"`` — exactly the rotate-me-out signal the
+  multi-replica serving router load-balances on (docs/SERVING.md
+  "Multi-replica router").
 
 Enable by setting ``FLEETX_OBS_PORT`` (``maybe_start_from_env`` is
 called by the Trainer and ServingEngine constructors, so any training
@@ -39,7 +46,9 @@ from fleetx_tpu.obs.tracing import get_recorder
 __all__ = [
     "ObsServer",
     "get_server",
+    "health_report",
     "health_status",
+    "healthz_payload",
     "maybe_start_from_env",
     "register_health",
     "snapshot_payload",
@@ -50,9 +59,12 @@ _health_lock = threading.Lock()
 _health_probes: Dict[str, Callable[[], bool]] = {}
 
 
-def register_health(name: str, probe: Callable[[], bool]) -> None:
+def register_health(name: str, probe: Callable[[], object]) -> None:
     """Register a named liveness probe for ``/healthz``. ``probe()``
-    returns True when healthy; a raising probe counts as failing. Re-
+    returns either a bool (True = healthy) or a report dict with a
+    ``state`` key (``"ok"`` = healthy; ``"draining"``/``"dead"`` are the
+    standard unhealthy states, extra keys like ``queue_depth``/``active``
+    ride into the healthz body); a raising probe counts as failing. Re-
     registering a name replaces it (callers pair with
     ``weakref.finalize`` to unregister at owner teardown)."""
     with _health_lock:
@@ -65,30 +77,94 @@ def unregister_health(name: str) -> None:
         _health_probes.pop(name, None)
 
 
-def health_status() -> Tuple[bool, Dict[str, bool]]:
-    """(all healthy, {probe name: healthy}) over the registered probes.
-    No probes registered = healthy (a bare process serves 200)."""
+def health_report() -> Tuple[bool, Dict[str, bool], Dict[str, Dict]]:
+    """(all healthy, {probe: healthy}, {probe: report dict}) over the
+    registered probes. Bool-returning probes get a synthesized report
+    (``state`` ``"ok"``/``"dead"`` — a bare bool carries no drain
+    nuance); dict-returning probes are healthy iff ``state == "ok"`` and
+    their report passes through verbatim. A raising probe is unhealthy
+    with the error in its report. No probes registered = healthy (a bare
+    process serves 200)."""
     with _health_lock:
         probes = dict(_health_probes)
-    results = {}
+    results, details = {}, {}
     for name, probe in probes.items():
         try:
-            results[name] = bool(probe())
-        except Exception:  # noqa: BLE001 — a broken probe is "unhealthy"
+            out = probe()
+        except Exception as e:  # noqa: BLE001 — a broken probe is unhealthy
             results[name] = False
-    return all(results.values()), results
+            details[name] = {"state": "dead",
+                             "error": f"{type(e).__name__}: {e}"}
+            continue
+        if isinstance(out, dict):
+            healthy = out.get("state") == "ok"
+            results[name] = healthy
+            if out.get("state") not in ("ok", "draining", "dead"):
+                # normalize reports without a recognized state so the
+                # body's aggregate can never contradict the status code
+                # (an unhealthy stateless report must aggregate as dead,
+                # not default to ok)
+                out = {**out, "state": "ok" if healthy else "dead"}
+            details[name] = out
+        else:
+            results[name] = bool(out)
+            details[name] = {"state": "ok" if out else "dead"}
+    return all(results.values()), results, details
+
+
+def health_status() -> Tuple[bool, Dict[str, bool]]:
+    """(all healthy, {probe name: healthy}) — the boolean view of
+    :func:`health_report` (kept for callers that only gate on 200/503)."""
+    ok, results, _ = health_report()
+    return ok, results
+
+
+def healthz_payload() -> Tuple[bool, Dict]:
+    """(healthy, the ``/healthz`` JSON body). The body leads with the
+    aggregate rotate-out reason — ``state`` is the WORST across probes
+    (``dead`` > ``draining`` > ``ok``) — and sums ``queue_depth``/
+    ``active`` over the probes that report them, so a single-engine
+    replica's body reads directly as that engine's health dict."""
+    ok, results, details = health_report()
+    states = [d.get("state", "ok") for d in details.values()]
+    state = ("dead" if "dead" in states
+             else "draining" if "draining" in states else "ok")
+
+    def total(key):
+        # probe reports are caller-supplied: a malformed load field must
+        # degrade to 0, never crash the handler (the contract is that a
+        # broken probe reads as unhealthy, not as a dead endpoint)
+        n = 0
+        for d in details.values():
+            try:
+                n += int(d.get(key, 0))
+            except (TypeError, ValueError):
+                pass
+        return n
+
+    body = {
+        "status": "ok" if ok else "unhealthy",
+        "state": state,
+        "queue_depth": total("queue_depth"),
+        "active": total("active"),
+        "probes": results,
+        "failing": sorted(n for n, v in results.items() if not v),
+        "detail": details,
+    }
+    return ok, body
 
 
 def snapshot_payload() -> Dict:
     """THE ``/snapshot`` payload (one definition — the HTTP handler and
     ``tools/obs_dump.py``'s in-process dump both serve exactly this, so
     the two surfaces cannot drift)."""
-    ok, results = health_status()
+    ok, body = healthz_payload()
     rec = get_recorder()
     return {
         "metrics": get_registry().snapshot(),
         "events": get_event_log().snapshot(),
-        "health": {"ok": ok, "probes": results},
+        "health": {"ok": ok, "state": body["state"],
+                   "probes": body["probes"], "detail": body["detail"]},
         "spans": {"recorded": len(rec.spans()),
                   "dropped": rec.dropped,
                   "capacity": rec.capacity},
@@ -118,12 +194,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, get_registry().prometheus_text().encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/healthz":
-            ok, results = health_status()
-            self._send_json(
-                200 if ok else 503,
-                {"status": "ok" if ok else "unhealthy",
-                 "probes": results,
-                 "failing": sorted(n for n, v in results.items() if not v)})
+            ok, body = healthz_payload()
+            self._send_json(200 if ok else 503, body)
         elif path == "/snapshot":
             self._send_json(200, snapshot_payload())
         elif path == "/trace":

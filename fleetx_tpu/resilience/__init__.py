@@ -12,8 +12,10 @@ from fleetx_tpu.resilience.faults import (
     FaultPlan,
     PoisonFault,
     PrefillFault,
+    ReplicaKilled,
     TickFault,
     faults,
 )
 
-__all__ = ["FaultPlan", "PoisonFault", "PrefillFault", "TickFault", "faults"]
+__all__ = ["FaultPlan", "PoisonFault", "PrefillFault", "ReplicaKilled",
+           "TickFault", "faults"]

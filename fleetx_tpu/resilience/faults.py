@@ -44,6 +44,23 @@ selector faults exactly once):
   dies in its own prefill is already isolated (the engine knows who it
   was admitting) and is covered by ``FLEETX_FAULT_PREFILL_RAISE``.
 
+Replica-level injection points (the multi-replica router failure
+domain, docs/RESILIENCE.md "Router failover"; the router calls both
+hooks — a process that runs one engine never pays more than the flag
+check):
+
+- ``FLEETX_FAULT_REPLICA_KILL``: ``"replica:tick"`` entries (comma-
+  separated) — the router's attempt to tick the matching replica at the
+  matching ROUTER tick raises ``ReplicaKilled`` (the process/device
+  behind that replica vanished mid-burst; each entry fires once). The
+  router marks the replica dead and migrates its in-flight requests.
+- ``FLEETX_FAULT_PROBE_FLAP``: ``"replica:times"`` entries — the
+  matching replica's next ``times`` health probes LIE (``state:
+  "dead"``) before telling the truth again, exercising the router's
+  bounded-backoff re-probe loop (a flap shorter than
+  ``FLEETX_ROUTER_PROBE_MAX`` failures must rotate the replica out and
+  back, never mark it dead).
+
 Batch/step selectors share one grammar: a comma-separated list of
 entries, each either an int (``"3"``), or ``"N+"`` for every index >= N
 (``"0+"`` = always). :func:`raising_on_token` builds the deterministic
@@ -68,6 +85,7 @@ __all__ = [
     "FaultPlan",
     "PoisonFault",
     "PrefillFault",
+    "ReplicaKilled",
     "TickFault",
     "faults",
     "raising_on_token",
@@ -96,6 +114,13 @@ class PoisonFault(RuntimeError):
     device step."""
 
 
+class ReplicaKilled(RuntimeError):
+    """Injected replica death (FLEETX_FAULT_REPLICA_KILL): the process or
+    device behind a router replica vanished — every further call into its
+    engine would hang or fail, so the router must rotate it out and
+    migrate its in-flight requests."""
+
+
 class _Selector:
     """Index selector: ``"3"``, ``"1,4"``, ``"2+"`` (every index >= 2)."""
 
@@ -119,6 +144,26 @@ class _Selector:
         return bool(self.exact) or self.from_ is not None
 
 
+def _parse_pairs(spec: str, what: str):
+    """Parse the replica-level ``"a:b"`` grammar — comma-separated
+    ``replica:value`` int pairs — into an ordered ``[(a, b), ...]``.
+    Malformed entries raise, naming the offending variable (a chaos run
+    must fail loudly, never silently skip its faults)."""
+    out = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            a, b = part.split(":")
+            out.append((int(a), int(b)))
+        except ValueError:
+            raise ValueError(
+                f"{what}={spec!r}: entries must be 'replica:N' int pairs "
+                "like '1:3' or '0:2,1:3'")
+    return out
+
+
 @dataclasses.dataclass
 class FaultPlan:
     """Parsed fault schedule (module docstring has the env grammar)."""
@@ -133,6 +178,8 @@ class FaultPlan:
     tick_hang: Optional[str] = None
     tick_hang_s: float = 30.0
     poison_request: Optional[str] = None
+    replica_kill: Optional[str] = None
+    probe_flap: Optional[str] = None
 
     @classmethod
     def from_env(cls, env=os.environ) -> Optional["FaultPlan"]:
@@ -159,11 +206,14 @@ class FaultPlan:
             tick_hang=env.get("FLEETX_FAULT_TICK_HANG") or None,
             tick_hang_s=_float("FLEETX_FAULT_TICK_HANG_S", 30.0),
             poison_request=env.get("FLEETX_FAULT_POISON_REQUEST") or None,
+            replica_kill=env.get("FLEETX_FAULT_REPLICA_KILL") or None,
+            probe_flap=env.get("FLEETX_FAULT_PROBE_FLAP") or None,
         )
         if not (plan.nan_batch or plan.data_raise_batch
                 or plan.data_slow_batch or plan.ckpt_save_step
                 or plan.tick_raise or plan.prefill_raise or plan.tick_hang
-                or plan.poison_request):
+                or plan.poison_request or plan.replica_kill
+                or plan.probe_flap):
             return None
         return plan
 
@@ -173,13 +223,15 @@ class FaultInjector:
 
     _ZERO = {"nan": 0, "data_raise": 0, "data_slow": 0, "ckpt": 0,
              "tick_raise": 0, "prefill_raise": 0, "tick_hang": 0,
-             "poison": 0}
+             "poison": 0, "replica_kill": 0, "probe_flap": 0}
 
     def __init__(self):
         self._plan: Optional[FaultPlan] = None
         self._nan_sel = self._raise_sel = self._slow_sel = self._ckpt_sel = None
         self._tick_sel = self._prefill_sel = self._hang_sel = None
         self._poison_sel = None
+        self._kill_pending = set()   # {(replica, router_tick)} unfired
+        self._flap_remaining = {}    # replica -> lying probes left
         self._batch_counter = 0
         self.injected = dict(self._ZERO)
 
@@ -189,7 +241,8 @@ class FaultInjector:
         if plan is None and kw:
             plan = FaultPlan(**{k: str(v) if v is not None
                                 and k.endswith(("batch", "step", "raise",
-                                                "hang", "request")) else v
+                                                "hang", "request", "kill",
+                                                "flap")) else v
                                 for k, v in kw.items()})
         def sel(field):
             spec = getattr(plan, field, None) if plan else None
@@ -211,6 +264,12 @@ class FaultInjector:
         self._prefill_sel = sel("prefill_raise")
         self._hang_sel = sel("tick_hang")
         self._poison_sel = sel("poison_request")
+        kill = getattr(plan, "replica_kill", None) if plan else None
+        flap = getattr(plan, "probe_flap", None) if plan else None
+        self._kill_pending = set(
+            _parse_pairs(kill, "FLEETX_FAULT_REPLICA_KILL") if kill else ())
+        self._flap_remaining = dict(
+            _parse_pairs(flap, "FLEETX_FAULT_PROBE_FLAP") if flap else ())
         self._batch_counter = 0
         self.injected = dict(self._ZERO)
 
@@ -322,6 +381,40 @@ class FaultInjector:
             raise PoisonFault(
                 f"injected poison-request failure (requests {hits} in the "
                 "decode batch, FLEETX_FAULT_POISON_REQUEST)")
+
+
+    def on_router_tick(self, replica: int, tick: int) -> None:
+        """Raise :class:`ReplicaKilled` when the router is about to tick
+        ``replica`` at router tick ``tick`` and an unfired
+        ``FLEETX_FAULT_REPLICA_KILL`` entry matches (each entry fires
+        exactly once — a killed process does not die twice)."""
+        if not self._kill_pending:
+            return
+        key = (int(replica), int(tick))
+        if key in self._kill_pending:
+            self._kill_pending.discard(key)
+            self.injected["replica_kill"] += 1
+            obs_emit("fault_injected", fault="replica_kill",
+                     replica=key[0], tick=key[1])
+            raise ReplicaKilled(
+                f"injected replica death: replica {key[0]} at router tick "
+                f"{key[1]} (FLEETX_FAULT_REPLICA_KILL)")
+
+    def on_router_probe(self, replica: int) -> Optional[dict]:
+        """A LYING health report for ``replica`` while its
+        ``FLEETX_FAULT_PROBE_FLAP`` budget lasts (None = probe honestly).
+        The lie is a ``state: "dead"`` healthz body — the worst rotate-out
+        reason — so the router's backoff/escalation path is the one under
+        test, not the report parser."""
+        remaining = self._flap_remaining.get(int(replica), 0)
+        if remaining <= 0:
+            return None
+        self._flap_remaining[int(replica)] = remaining - 1
+        self.injected["probe_flap"] += 1
+        obs_emit("fault_injected", fault="probe_flap", replica=int(replica),
+                 remaining=remaining - 1)
+        return {"state": "dead", "queue_depth": 0, "active": 0,
+                "injected": True}
 
 
 def raising_on_token(after_tokens: int = 1, record: Optional[list] = None):

@@ -21,7 +21,9 @@ Layout: ``cache_manager`` (page pool + prefix trie + slot-compat cache,
 and the no-zeroing live-window safety argument), ``scheduler`` (FIFO
 admission policy seam), ``engine`` (submit/step/drain loop + jitted
 prefill/decode), ``metrics`` (queue/TTFT/throughput/prefix-reuse
-observability). docs/SERVING.md has the architecture tour.
+observability), ``router`` (N-replica dispatch, health-based failover,
+zero-token-loss migration), ``workload`` (seeded trace generation + the
+SLO goodput scorer). docs/SERVING.md has the architecture tour.
 """
 
 from fleetx_tpu.serving.cache_manager import (
@@ -41,11 +43,26 @@ from fleetx_tpu.serving.engine import (
     sample_tokens,
 )
 from fleetx_tpu.serving.metrics import ServingMetrics
+from fleetx_tpu.serving.router import (
+    ReplicaState,
+    RouterMetrics,
+    ServingRouter,
+)
 from fleetx_tpu.serving.scheduler import FIFOScheduler, Request
 from fleetx_tpu.serving.spec import (
     DraftModelProposer,
     NgramProposer,
     Proposer,
+)
+from fleetx_tpu.serving.workload import (
+    RequestOutcome,
+    TenantSpec,
+    TraceRequest,
+    WorkloadSpec,
+    generate_trace,
+    run_trace,
+    score_goodput,
+    trace_hash,
 )
 
 __all__ = [
@@ -64,7 +81,18 @@ __all__ = [
     "DraftModelProposer",
     "NgramProposer",
     "Proposer",
+    "ReplicaState",
+    "RequestOutcome",
+    "RouterMetrics",
     "ServingMetrics",
+    "ServingRouter",
+    "TenantSpec",
+    "TraceRequest",
+    "WorkloadSpec",
+    "generate_trace",
+    "run_trace",
     "sample_tokens",
     "scatter_slot",
+    "score_goodput",
+    "trace_hash",
 ]

@@ -72,6 +72,7 @@ from __future__ import annotations
 
 import heapq
 import math
+import struct
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -173,6 +174,98 @@ class HostPageStore:
             f"host store nbytes {self.nbytes} != sum of entries {want}")
         assert self.nbytes <= self.capacity_bytes, (
             f"host store over budget: {self.nbytes} > {self.capacity_bytes}")
+
+    # ------------------------------------------------- payload wire format
+    # One spilled page's payload is a per-cache-leaf list of host arrays
+    # (K page, V page, int8 scale pages when quantized) with None holding
+    # the slots of rank-<4 leaves (the cache_index scalars that never
+    # spill). to_bytes/from_bytes give that payload a PICKLE-FREE,
+    # byte-exact wire form — the page-ship primitive a cross-replica
+    # prefill/decode split serializes over the network (ROADMAP item 2),
+    # with none of pickle's arbitrary-code-execution surface on the
+    # receiving replica. Layout (little-endian): magic "FXPG" + u16
+    # version + u16 entry count, then per entry a none/array flag and,
+    # for arrays, dtype string + shape + raw C-order bytes.
+
+    _MAGIC = b"FXPG"
+    _VERSION = 1
+
+    @staticmethod
+    def payload_to_bytes(payload) -> bytes:
+        """Serialize one spill payload (list of ``Optional[np.ndarray]``)
+        to the wire format above. Byte-exact: dtypes (int8 values, fp32
+        scales, bf16 via its numpy extension name) and shapes round-trip
+        losslessly through :meth:`payload_from_bytes`."""
+        out = [HostPageStore._MAGIC,
+               struct.pack("<HH", HostPageStore._VERSION, len(payload))]
+        for arr in payload:
+            if arr is None:
+                out.append(b"\x00")
+                continue
+            a = np.ascontiguousarray(arr)
+            if a.dtype.names is not None or a.dtype.hasobject:
+                raise ValueError(
+                    f"payload leaf dtype {a.dtype} is not a plain array "
+                    "dtype; only numeric cache leaves spill")
+            # dtype.name, not dtype.str: the extension dtypes (bfloat16)
+            # stringify as opaque void types under .str but round-trip
+            # through np.dtype(name) once ml_dtypes is registered (jax
+            # imports it)
+            name = a.dtype.name.encode("ascii")
+            out.append(b"\x01")
+            out.append(struct.pack("<B", len(name)))
+            out.append(name)
+            out.append(struct.pack("<B", a.ndim))
+            out.append(struct.pack(f"<{a.ndim}I", *a.shape))
+            raw = a.tobytes()
+            out.append(struct.pack("<Q", len(raw)))
+            out.append(raw)
+        return b"".join(out)
+
+    @staticmethod
+    def payload_from_bytes(buf: bytes) -> list:
+        """Inverse of :meth:`payload_to_bytes` (malformed/truncated input
+        raises ValueError — a corrupt shipped page must fail loudly, not
+        revive garbage K/V)."""
+        view = memoryview(buf)
+        if bytes(view[:4]) != HostPageStore._MAGIC:
+            raise ValueError("not a HostPageStore payload (bad magic)")
+        pos, out = 8, []
+        try:
+            version, count = struct.unpack("<HH", view[4:8])
+            if version != HostPageStore._VERSION:
+                raise ValueError(f"unsupported payload version {version}")
+            for _ in range(count):
+                flag = view[pos]
+                pos += 1
+                if flag == 0:
+                    out.append(None)
+                    continue
+                nlen = view[pos]
+                pos += 1
+                dtype = np.dtype(bytes(view[pos:pos + nlen]).decode("ascii"))
+                pos += nlen
+                ndim = view[pos]
+                pos += 1
+                shape = struct.unpack(f"<{ndim}I",
+                                      view[pos:pos + 4 * ndim])
+                pos += 4 * ndim
+                (nbytes,) = struct.unpack("<Q", view[pos:pos + 8])
+                pos += 8
+                arr = np.frombuffer(
+                    view[pos:pos + nbytes], dtype=dtype).reshape(shape)
+                pos += nbytes
+                out.append(arr.copy())  # own the memory, not the buffer
+        except (struct.error, ValueError, IndexError, TypeError) as e:
+            # IndexError: memoryview read past a truncation point;
+            # TypeError: np.dtype() on a truncated dtype name — both are
+            # the same "corrupt payload" condition the contract promises
+            # to surface as ValueError
+            raise ValueError(f"truncated/corrupt payload: {e}") from None
+        if pos != len(buf):
+            raise ValueError(
+                f"payload has {len(buf) - pos} trailing bytes")
+        return out
 
 
 def scatter_slot(cache, prefill_cache, slot):

@@ -185,6 +185,15 @@ Crash safety (docs/RESILIENCE.md serving-recovery):
   retires whatever remains with partial tokens and
   ``finish_reason="shutdown"`` — the hook a multi-replica router needs
   to rotate a replica out without dropping a byte.
+- **Admit-with-history** — ``submit(history=...)`` aims the replay seam
+  at a request ANOTHER replica started: the pre-emitted tokens replay
+  through the same one-call prefill recovery uses, the RNG position
+  reconstructs, and decoding continues from the last delivered token
+  without re-firing its callbacks — the zero-token-loss failover
+  primitive of the multi-replica router (serving/router.py). The
+  :meth:`health`/:meth:`take_result`/:meth:`emitted_tokens`/
+  :meth:`declare_dead` quartet is the rest of the router-facing
+  surface.
 """
 
 from __future__ import annotations
@@ -625,7 +634,9 @@ class ServingEngine:
             eng = ref()
             if eng is None:
                 return True  # owner gone; finalize unregisters shortly
-            return not (eng._shutting_down or eng._dead)
+            # the full healthz body (state/queue_depth/active), not a bare
+            # bool: the router and external LBs get a rotate-out REASON
+            return eng.health()
 
         obs_http.register_health(self._health_name, _healthy)
         weakref.finalize(self, obs_http.unregister_health, self._health_name)
@@ -640,7 +651,8 @@ class ServingEngine:
                top_k: Optional[int] = None, top_p: Optional[float] = None,
                seed: Optional[int] = None, rng_key: Optional[jax.Array] = None,
                on_token=None, queue_ttl_s: Optional[float] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               history=None) -> int:
         """Queue one request; returns its id. Kwargs override the engine's
         ``gen_cfg`` defaults per request; ``seed`` (or a raw ``rng_key``)
         pins this request's private sampling stream, ``on_token`` streams
@@ -648,7 +660,22 @@ class ServingEngine:
         ``queue_ttl_s``/``deadline_s`` override the engine's admission
         limits (0 disables). Raises :class:`QueueFull` when the bounded
         queue is at ``FLEETX_SERVING_MAX_QUEUE`` and :class:`ShuttingDown`
-        once :meth:`shutdown`/:meth:`request_shutdown` has been called."""
+        once :meth:`shutdown`/:meth:`request_shutdown` has been called.
+
+        ``history`` is the ADMIT-WITH-HISTORY seam (the multi-replica
+        router's zero-token-loss failover, docs/SERVING.md): tokens this
+        request already emitted on another replica before it died. The
+        request admits through the replay prefill seam — its
+        ``prompt + history[:-1]`` K/V rebuilt in one call, its RNG stream
+        advanced to exactly the position ``len(history)`` emitted tokens
+        would have consumed (so sampling continues the SAME stream the
+        original ``rng_key`` defines — pass the original key) — and
+        decoding continues from ``history[-1]``. History tokens count
+        against ``max_length`` and ride the final result, but ``on_token``
+        fires only for NEWLY decoded tokens (the caller already delivered
+        the history). A history that is already terminal (ends in EOS, or
+        exhausts ``max_length``) is a caller bug and raises ValueError —
+        migrate unfinished requests only."""
         if self._shutting_down:
             self.metrics.record_drain_reject()
             obs_emit("drain_reject", engine=self.metrics.engine_label)
@@ -705,6 +732,19 @@ class ServingEngine:
                 "serving: request %d top_k %d clamped to topk_cap %d "
                 "(FLEETX_SERVING_TOPK_CAP)", self._next_id, tk, self.topk_cap)
             tk = self.topk_cap
+        hist = ([] if history is None
+                else [int(t) for t in np.asarray(history,
+                                                 np.int64).reshape(-1)])
+        if hist:
+            if eos >= 0 and hist[-1] == eos:
+                raise ValueError(
+                    f"history of {len(hist)} tokens already ends in EOS "
+                    f"({eos}) — the request is terminal; do not migrate it")
+            if max_new <= len(hist):
+                raise ValueError(
+                    f"history ({len(hist)} tokens) meets or exceeds the "
+                    f"max_length budget ({max_new}) — the request is "
+                    "terminal; do not migrate it")
         rid = self._next_id
         self._next_id += 1
         if rng_key is None:
@@ -725,6 +765,11 @@ class ServingEngine:
             deadline_s=float(deadline_s if deadline_s is not None
                              else self.deadline_s),
         )
+        # admit-with-history: the pre-emitted tokens ARE the request's
+        # token list from the start (a queue-expiry or shutdown retirement
+        # before admission must still return them — zero token loss), and
+        # _admit routes a non-empty list through the replay prefill seam
+        req.tokens.extend(hist)
         self.scheduler.submit(req)
         self.metrics.record_submit()
         return rid
@@ -1384,6 +1429,50 @@ class ServingEngine:
         """Finished result for ``request_id`` (None while in flight)."""
         return self._results.get(request_id)
 
+    def take_result(self, request_id: int) -> Optional[ServingResult]:
+        """Remove and return one finished result (None while in flight).
+        The per-request sibling of :meth:`drain`'s return-and-clear — a
+        router collecting results every tick consumes them one at a time
+        without resetting the whole table."""
+        return self._results.pop(request_id, None)
+
+    def emitted_tokens(self, request_id: int) -> Optional[list]:
+        """Host-truth copy of a live request's emitted tokens (None for
+        unknown/finished ids). The router's stream-reconciliation seam:
+        after a recovered tick it re-bases its durable per-request history
+        on the engine's rolled-back-and-replayed token list — the in-
+        process analogue of a streaming client re-syncing its offset."""
+        for r in (list(self._active.values())
+                  + list(self._prefilling.values())
+                  + list(self.scheduler.snapshot())):
+            if r.id == request_id:
+                return list(r.tokens)
+        return None
+
+    def health(self) -> Dict:
+        """The drain-aware health report (the ``/healthz`` JSON body,
+        docs/OBSERVABILITY.md): ``state`` is ``"ok"`` while serving,
+        ``"draining"`` once :meth:`request_shutdown` flipped admission
+        off (rotate out, results still coming), ``"dead"`` after
+        :class:`RecoveryExhausted`/:meth:`declare_dead` (rotate out,
+        nothing more is coming). ``queue_depth``/``active`` give the
+        load-balancing signal next to the rotate-out reason — the
+        contract the multi-replica router and any external LB consume."""
+        state = ("dead" if self._dead
+                 else "draining" if self._shutting_down else "ok")
+        return {"state": state,
+                "queue_depth": self.scheduler.queue_depth,
+                "active": len(self._active) + len(self._prefilling),
+                "slots": self.slots}
+
+    def declare_dead(self) -> None:
+        """Mark the engine dead (``health()``/``/healthz`` report
+        ``"dead"``) without running its shutdown machinery — the seam for
+        a supervisor/router that has decided the process or device behind
+        this engine is gone (e.g. the replica-kill chaos path). Ticking a
+        declared-dead engine is the caller's bug, not prevented here."""
+        self._dead = True
+
     # ------------------------------------------------------------- internals
 
     def _init_state(self):
@@ -1549,14 +1638,25 @@ class ServingEngine:
             "rng": st["rng"].at[slot].set(key),
         }
 
+    def _admission_tokens(self, req: Request) -> np.ndarray:
+        """The tokens admission must find storage for: the prompt alone
+        for a fresh request, ``prompt + history[:-1]`` for an admit-with-
+        history request (the last history token's K/V write is the next
+        decode tick's job, exactly the replay contract)."""
+        if req.tokens:
+            return np.concatenate(
+                [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
+        return req.prompt
+
     def _can_admit(self, req: Request) -> bool:
         """FIFO-head admission judgment: a free decode lane, and — paged —
-        enough free pages for the head's prompt (page-granular admission:
-        total live tokens gate entry, not worst-case slot capacity). A
-        too-big head BLOCKS, preserving arrival order deterministically;
-        it unblocks as retiring requests return pages."""
+        enough free pages for the head's prompt plus any migrated history
+        (page-granular admission: total live tokens gate entry, not
+        worst-case slot capacity). A too-big head BLOCKS, preserving
+        arrival order deterministically; it unblocks as retiring requests
+        return pages."""
         if self.paged:
-            return self.cache_manager.can_admit(req.prompt)
+            return self.cache_manager.can_admit(self._admission_tokens(req))
         return self.cache_manager.free_count > 0
 
     def _device_tables(self):
@@ -1844,7 +1944,25 @@ class ServingEngine:
         """Admit the FIFO head: claim storage, then either the one-call
         whole-suffix prefill (chunking off, or the non-shared suffix fits
         one chunk — today's path, byte-identical) or enter the
-        ``prefilling`` state and run the first chunk."""
+        ``prefilling`` state and run the first chunk. A request carrying
+        migrated history (``submit(history=...)``) admits through the
+        replay seam instead: one whole-history prefill + lane install
+        with the RNG position reconstructed, no callbacks re-fired —
+        byte-for-byte the recovery replay of PR 8, aimed at a request
+        another replica started."""
+        if req.tokens:
+            self._fault_ctx = ("prefill", req.id)
+            with span("serving.admit", request=req.id,
+                      prompt_len=req.prompt_len, history=len(req.tokens)):
+                self._replay(req)
+            self._fault_ctx = None
+            self._prefill_strikes.pop(req.id, None)
+            now = self._now()
+            req.admit_time = now
+            self.metrics.record_admit(now - req.submit_time)
+            req.phase = "active"
+            self._active[req.slot] = req
+            return
         self._fault_ctx = ("prefill", req.id)
         with span("serving.admit", request=req.id,
                   prompt_len=req.prompt_len):

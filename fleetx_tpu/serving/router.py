@@ -1,0 +1,1014 @@
+"""ServingRouter: health-aware dispatch + zero-token-loss failover over
+N ``ServingEngine`` replicas.
+
+One replica is now production-shaped (paged, crash-safe, observable,
+quantized, mesh-sharded) — but "heavy traffic from millions of users"
+means N replicas, and replicas FAIL. This module is the replica-level
+failure domain: the router fronts N engines (each optionally a mesh
+slice, docs/SERVING.md "Mesh-sharded serving") and turns the library
+into a deployable service whose availability story does not end at one
+process's ``recover()``.
+
+**Dispatch.** Requests queue in the router (bounded by
+``FLEETX_ROUTER_MAX_QUEUE`` — a full queue rejects with
+:class:`~fleetx_tpu.serving.engine.QueueFull`, the same explicit
+backpressure contract as the engine) and dispatch FIFO to the
+least-loaded in-rotation replica, scored by its health report's
+``queue_depth + active``. PREFIX AFFINITY pins sessions to warm caches:
+the hash of a prompt's longest full-page prefix maps to the replica
+whose refcounted trie already owns those pages (recorded at first
+dispatch), so a template/system-prompt workload keeps hitting the same
+replica's warm trie instead of re-prefilling on a random one. Affinity
+falls back to least-loaded the moment its replica is rotated out or its
+queue is full — a preference, never a correctness dependency.
+
+**Health-based rotate-out.** Each replica is probed through the PR 9
+``/healthz`` contract — in-process the router calls
+``ServingEngine.health()`` directly, which returns exactly the JSON
+body the HTTP endpoint serves (``state`` ok/draining/dead + queue
+depth + active), so a cross-process router consuming ``GET /healthz``
+sees the identical report. ``draining`` rotates the replica out of
+dispatch but keeps ticking it (it is finishing its own work — SIGTERM
+drain); ``dead`` or a raising probe makes it a SUSPECT: rotated out,
+re-probed on a bounded exponential backoff
+(``FLEETX_ROUTER_PROBE_BACKOFF`` ticks, doubling per consecutive
+failure), and only after ``FLEETX_ROUTER_PROBE_MAX`` consecutive
+failures marked DEAD — a transient probe flap (network blip, the
+``FLEETX_FAULT_PROBE_FLAP`` injector) costs a rotation round-trip,
+never a replica.
+
+**Zero-token-loss failover.** The router durably holds every request's
+prompt + emitted-token history, fed from the engine's existing
+``on_token`` callbacks (the in-process stand-in for the streaming
+response a network router proxies — the history IS what the client has
+already seen). When a replica dies — killed mid-burst, probe
+escalation, or :class:`RecoveryExhausted` out of its ``step()`` — its
+in-flight requests re-queue at the router head in submission order and
+re-dispatch to a survivor with ``submit(history=...)``: the engine's
+admit-with-history seam replays ``prompt + history[:-1]`` through the
+PR 8 replay prefill (one call, prefix-trie-shared), reconstructs the
+request's RNG position, and decoding continues from the last delivered
+token. Greedy streams are BYTE-IDENTICAL to a never-killed run;
+sampling streams are RNG-position-exact because the router re-sends
+the same per-request key. History tokens are never re-emitted through
+``on_token`` — the client already has them.
+
+**Graceful degradation.** Queued requests past their ``queue_ttl_s`` /
+``deadline_s`` are shed with ``finish_reason="timeout"`` (partial
+tokens kept for migrated requests) instead of clogging the queue;
+dispatch forwards the REMAINING deadline to the replica so the global
+budget holds across migrations. A replica that turns suspect triggers
+HEDGED re-dispatch (``FLEETX_ROUTER_HEDGE``): its requests migrate to
+survivors immediately rather than waiting out the probe escalation,
+and if the suspect later proves healthy the router cancels the stale
+engine-side copies before ticking it again — EXACTLY-ONE-RESULT is the
+invariant (every submitted request reaches exactly one terminal
+:class:`ServingResult`; duplicates are structurally impossible because
+a result only finalizes through the single dispatched-map entry and
+``_finalize`` is idempotent). If every replica is dead the router
+strands the remainder loudly (``finish_reason="error"``,
+``router_stranded`` event) rather than hanging its caller.
+
+Streaming callbacks keep the ENGINE's delivery semantics: tokens arrive
+in order, and only a fault that rolls back an already-emitted token can
+re-deliver it (the engine's at-least-once-under-fault contract); the
+final result token list is always exact. After a replica recovers
+in-place (rolled-back tick), the router re-bases its history from
+``engine.emitted_tokens`` — the in-process analogue of a streaming
+client re-syncing its stream offset on resume.
+
+The router is synchronous and single-threaded like the engine: one
+``step()`` probes, dispatches, ticks every live replica once, and
+collects results. ``drain()`` loops to completion; ``shutdown()``
+drains every replica gracefully and finalizes the rest. Observability:
+``fleetx_router_*`` metrics + ``replica_out`` / ``replica_back`` /
+``replica_dead`` / ``request_migrated`` events
+(docs/OBSERVABILITY.md); chaos coverage in ``tools/chaos_check.py``
+(``router_kill``, ``router_saturation``) and the SLO goodput record in
+``tools/bench_serving.py`` (serving/workload.py generates the trace).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+import weakref
+import zlib
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from fleetx_tpu.obs.events import emit as obs_emit
+from fleetx_tpu.obs.registry import get_registry
+from fleetx_tpu.obs.tracing import span
+from fleetx_tpu.resilience.faults import ReplicaKilled, faults
+from fleetx_tpu.serving.engine import (
+    QueueFull,
+    RecoveryExhausted,
+    ServingResult,
+    ShuttingDown,
+    _env_float,
+    _env_int,
+)
+from fleetx_tpu.serving.metrics import _drop_series
+from fleetx_tpu.utils.log import logger
+
+__all__ = ["ReplicaState", "RouterMetrics", "ServingRouter"]
+
+
+class ReplicaState:
+    """Replica lifecycle states (module docstring "rotate-out")."""
+
+    OK = "ok"              # in rotation: receives dispatches, ticked
+    SUSPECT = "suspect"    # probe failing: out of rotation, backoff re-probe
+    DRAINING = "draining"  # finishing its own work: ticked, no dispatches
+    DEAD = "dead"          # gone: never touched again, requests migrated
+
+
+@dataclasses.dataclass
+class _Replica:
+    """One fronted engine + the router's view of it."""
+
+    index: int
+    engine: object
+    state: str = ReplicaState.OK
+    probe_failures: int = 0          # consecutive non-ok probes
+    next_probe_tick: int = 0         # backoff schedule while suspect
+    dispatched: Dict[int, int] = dataclasses.field(default_factory=dict)
+    # engine rids hedged away while suspect; cancelled if/when it rejoins
+    stale: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class _RouterRequest:
+    """One router-level request across dispatches/migrations."""
+
+    rid: int
+    prompt: np.ndarray
+    kw: Dict                      # engine submit kwargs (decode knobs)
+    rng_key: jax.Array            # SAME key at every dispatch (RNG parity)
+    on_token: Optional[object]
+    submit_time: float
+    queue_ttl_s: float
+    deadline_s: float
+    # when THIS queue residency began: reset at every (re-)enqueue, so
+    # the queue TTL measures waiting — a migrated request that already
+    # ran for minutes must not be shed the instant it re-queues
+    # (deadline_s stays anchored to submit_time: total lifetime)
+    queued_since: float = 0.0
+    affinity_key: Optional[int] = None
+    state: str = "queued"         # queued | dispatched | finished
+    replica: Optional[int] = None
+    engine_rid: Optional[int] = None
+    dispatches: int = 0
+    first_token_time: Optional[float] = None
+    tokens: List[int] = dataclasses.field(default_factory=list)
+
+
+class RouterMetrics:
+    """``fleetx_router_*`` registry instruments for one router, labeled
+    ``router="<n>"`` (docs/OBSERVABILITY.md has the table). The same
+    owned-series + weakref-finalize discipline as ``ServingMetrics``:
+    cycling routers cannot grow ``/metrics`` forever."""
+
+    _labels = itertools.count()
+
+    def __init__(self, registry=None):
+        reg = registry or get_registry()
+        self.router_label = str(next(self._labels))
+        lab = {"router": self.router_label}
+        self._owned = owned = []
+
+        def child(fam):
+            owned.append((fam, dict(lab)))
+            return fam.labels(**lab)
+
+        def counter(name, help):
+            return child(reg.counter(name, help, ("router",)))
+
+        def gauge(name, help):
+            return child(reg.gauge(name, help, ("router",)))
+
+        def hist(name, help):
+            return child(reg.histogram(name, help, ("router",)))
+
+        self._g_replicas = gauge(
+            "fleetx_router_replicas",
+            "Replicas this router fronts (dead ones included)")
+        self._g_in_rotation = gauge(
+            "fleetx_router_replicas_in_rotation",
+            "Replicas currently receiving dispatches (state ok)")
+        self._g_queue_depth = gauge(
+            "fleetx_router_queue_depth",
+            "Requests waiting in the router-level queue")
+        self._c_ticks = counter(
+            "fleetx_router_ticks_total", "Router scheduler ticks executed")
+        self._c_dispatched = counter(
+            "fleetx_router_dispatched_total",
+            "Dispatches to a replica (migrations re-count)")
+        self._c_affinity = counter(
+            "fleetx_router_affinity_hits_total",
+            "Dispatches placed by prefix affinity (warm-trie pin)")
+        self._c_migrated = counter(
+            "fleetx_router_migrated_total",
+            "In-flight requests migrated off a suspect/dead replica")
+        self._c_deaths = counter(
+            "fleetx_router_replica_deaths_total",
+            "Replicas marked dead (probe escalation, kill, "
+            "RecoveryExhausted)")
+        self._c_probe_failures = counter(
+            "fleetx_router_probe_failures_total",
+            "Health probes that returned non-ok or raised")
+        self._c_rejected = counter(
+            "fleetx_router_rejected_total",
+            "Submits refused by the bounded router queue")
+        self._c_shed = counter(
+            "fleetx_router_shed_total",
+            "Queued requests shed by queue-TTL/deadline expiry")
+        self._finished_family = reg.counter(
+            "fleetx_router_finished_total",
+            "Requests that reached their one terminal result, by reason",
+            ("router", "reason"))
+        self._h_ttft = hist(
+            "fleetx_router_ttft_seconds",
+            "Router submit -> first token on the host (end-to-end across "
+            "queueing, dispatch, and any migration)")
+        self._h_latency = hist(
+            "fleetx_router_request_latency_seconds",
+            "Router submit -> terminal result latency")
+        self._h_queue_depth = hist(
+            "fleetx_router_queue_depth_per_tick",
+            "Router queue depth sampled once per tick")
+        self._reasons: Dict[str, object] = {}
+        weakref.finalize(self, _drop_series, owned)
+
+    def record_reject(self) -> None:
+        """A submit was refused by the bounded router queue."""
+        self._c_rejected.inc()
+
+    def record_shed(self) -> None:
+        """A queued request was shed by TTL/deadline expiry."""
+        self._c_shed.inc()
+
+    def record_probe_failure(self) -> None:
+        """A health probe returned non-ok or raised."""
+        self._c_probe_failures.inc()
+
+    def record_dispatch(self, affinity: bool) -> None:
+        """One dispatch placed (``affinity`` = via the prefix pin)."""
+        self._c_dispatched.inc()
+        if affinity:
+            self._c_affinity.inc()
+
+    def record_migrated(self) -> None:
+        """One in-flight request migrated off its replica."""
+        self._c_migrated.inc()
+
+    def record_replica_death(self) -> None:
+        """One replica was marked dead."""
+        self._c_deaths.inc()
+
+    def record_finished(self, reason: str, latency_s: float) -> None:
+        """One request reached its terminal result."""
+        child = self._reasons.get(reason)
+        if child is None:
+            labels = {"router": self.router_label, "reason": reason}
+            self._owned.append((self._finished_family, labels))
+            child = self._reasons[reason] = self._finished_family.labels(
+                **labels)
+        child.inc()
+        self._h_latency.observe(latency_s)
+
+    def observe_ttft(self, ttft_s: float) -> None:
+        """First token of a request reached the caller."""
+        self._h_ttft.observe(ttft_s)
+
+    def observe_tick(self, queue_depth: int, replicas: int,
+                     in_rotation: int) -> None:
+        """Per-tick gauge sample."""
+        self._c_ticks.inc()
+        self._g_queue_depth.set(queue_depth)
+        self._g_replicas.set(replicas)
+        self._g_in_rotation.set(in_rotation)
+        self._h_queue_depth.observe(queue_depth)
+
+    @property
+    def finish_reasons(self) -> Dict[str, int]:
+        """``{finish_reason: count}`` over terminal results."""
+        return {r: int(c.value) for r, c in self._reasons.items()
+                if int(c.value)}
+
+    def snapshot(self) -> Dict:
+        """Aggregate dict the benches/tests consume."""
+        ticks = int(self._c_ticks.value)
+        ttft_p50, ttft_p99 = self._h_ttft.quantiles((50, 99))
+        lat_p50, lat_p99 = self._h_latency.quantiles((50, 99))
+        return {
+            "replicas": int(self._g_replicas.value),
+            "replicas_in_rotation": int(self._g_in_rotation.value),
+            "queue_depth": int(self._g_queue_depth.value),
+            "queue_depth_mean": (self._h_queue_depth.sum / ticks
+                                 if ticks else 0.0),
+            "ticks": ticks,
+            "dispatched": int(self._c_dispatched.value),
+            "affinity_hits": int(self._c_affinity.value),
+            "migrated": int(self._c_migrated.value),
+            "replica_deaths": int(self._c_deaths.value),
+            "probe_failures": int(self._c_probe_failures.value),
+            "rejected": int(self._c_rejected.value),
+            "shed": int(self._c_shed.value),
+            "finished": sum(self.finish_reasons.values()),
+            "finish_reasons": self.finish_reasons,
+            "ttft_s_p50": ttft_p50,
+            "ttft_s_p99": ttft_p99,
+            "latency_s_p50": lat_p50,
+            "latency_s_p99": lat_p99,
+        }
+
+
+class ServingRouter:
+    """Fault-tolerant request router over N serving replicas (module
+    docstring). ``replicas`` is a list of constructed ``ServingEngine``s
+    — each replica's slots/pages/mesh are its own capacity, the router
+    only consumes the submit/step/health/result surface."""
+
+    _AFFINITY_CAP = 65536  # prefix pins kept (insertion-ordered, oldest out)
+
+    def __init__(self, replicas, *, max_queue: Optional[int] = None,
+                 queue_ttl_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None,
+                 probe_every: Optional[int] = None,
+                 probe_max_failures: Optional[int] = None,
+                 probe_backoff_ticks: Optional[int] = None,
+                 hedge: Optional[bool] = None,
+                 affinity: Optional[bool] = None,
+                 base_seed: int = 0,
+                 metrics: Optional[RouterMetrics] = None):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self._replicas = [_Replica(index=i, engine=e)
+                          for i, e in enumerate(replicas)]
+        self.max_queue = (max_queue if max_queue is not None
+                          else _env_int("FLEETX_ROUTER_MAX_QUEUE", 0))
+        self.queue_ttl_s = (queue_ttl_s if queue_ttl_s is not None
+                            else _env_float("FLEETX_ROUTER_QUEUE_TTL_S", 0.0))
+        self.deadline_s = (deadline_s if deadline_s is not None
+                           else _env_float("FLEETX_ROUTER_DEADLINE_S", 0.0))
+        # probing cadence: in-process probes are a method call, so the
+        # default probes every tick; a cross-process router GETting
+        # /healthz raises this to its scrape budget
+        self.probe_every = max(1, probe_every if probe_every is not None
+                               else _env_int("FLEETX_ROUTER_PROBE_EVERY", 1))
+        self.probe_max_failures = max(
+            1, probe_max_failures if probe_max_failures is not None
+            else _env_int("FLEETX_ROUTER_PROBE_MAX", 3))
+        self.probe_backoff_ticks = max(
+            1, probe_backoff_ticks if probe_backoff_ticks is not None
+            else _env_int("FLEETX_ROUTER_PROBE_BACKOFF", 2))
+        self.hedge = (hedge if hedge is not None
+                      else _env_int("FLEETX_ROUTER_HEDGE", 1) == 1)
+        self.affinity = (affinity if affinity is not None
+                         else _env_int("FLEETX_ROUTER_AFFINITY", 1) == 1)
+        # affinity granularity: the page is the trie-sharing unit, so the
+        # pinned prefix is the longest FULL-page run (0 disables when the
+        # fleet is not paged — there is no warm trie to pin to)
+        page_sizes = {e.page_size for e in replicas if e.paged}
+        self._affinity_page = min(page_sizes) if page_sizes else 0
+        self._affinity_map: Dict[int, int] = {}  # prefix hash -> replica
+        # the tightest per-request capacity across the fleet, so caller
+        # mistakes (over-long prompts, unservable strategies) raise AT
+        # SUBMIT like the engine's contract — not as a delayed
+        # finish_reason="error" result out of the first dispatch
+        self._limit = min(
+            min(e.cache_len, e.model.cfg.max_position_embeddings)
+            for e in replicas)
+        self._base_key = jax.random.PRNGKey(base_seed)
+        self.metrics = metrics or RouterMetrics()
+        self._queue: List[_RouterRequest] = []
+        self._requests: Dict[int, _RouterRequest] = {}
+        self._results: Dict[int, ServingResult] = {}
+        self._next_id = 0
+        self._ticks = 0
+        self._shutting_down = False
+        self._now = time.perf_counter  # swappable clock (chaos tests)
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, prompt, *, max_length: Optional[int] = None,
+               min_length: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               decode_strategy: Optional[str] = None,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None, top_p: Optional[float] = None,
+               seed: Optional[int] = None, on_token=None,
+               queue_ttl_s: Optional[float] = None,
+               deadline_s: Optional[float] = None) -> int:
+        """Queue one request; returns its router-level id. The kwargs
+        mirror ``ServingEngine.submit`` (they are forwarded verbatim at
+        every dispatch); ``seed`` pins the request's sampling stream —
+        the SAME key re-sends at each migration, which is what makes
+        sampling failover RNG-position-exact. Raises
+        :class:`QueueFull` at the ``FLEETX_ROUTER_MAX_QUEUE`` bound and
+        :class:`ShuttingDown` after :meth:`shutdown` began."""
+        if self._shutting_down:
+            raise ShuttingDown(
+                "router is shutting down; submit to another cluster")
+        if self.max_queue and len(self._queue) >= self.max_queue:
+            self._shed_expired(self._now())  # dead entries don't hold slots
+        if self.max_queue and len(self._queue) >= self.max_queue:
+            self.metrics.record_reject()
+            obs_emit("queue_reject", router=self.metrics.router_label,
+                     queue_depth=len(self._queue))
+            raise QueueFull(
+                f"router queue is full ({len(self._queue)}/{self.max_queue}"
+                " waiting); retry later or raise FLEETX_ROUTER_MAX_QUEUE")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if decode_strategy is not None and decode_strategy not in (
+                "greedy", "sampling"):
+            raise ValueError(
+                f"decode_strategy {decode_strategy!r} not servable by "
+                "continuous batching (beam search needs one-shot "
+                "generate())")
+        if prompt.size >= self._limit:
+            raise ValueError(
+                f"prompt_len {prompt.size} leaves no decode room on any "
+                f"replica (tightest cache/position limit {self._limit})")
+        rid = self._next_id
+        self._next_id += 1
+        rng_key = (jax.random.PRNGKey(int(seed)) if seed is not None
+                   else jax.random.fold_in(self._base_key, rid))
+        kw = {}
+        for name, value in (("max_length", max_length),
+                            ("min_length", min_length),
+                            ("eos_token_id", eos_token_id),
+                            ("decode_strategy", decode_strategy),
+                            ("temperature", temperature),
+                            ("top_k", top_k), ("top_p", top_p)):
+            if value is not None:
+                kw[name] = value
+        now = self._now()
+        req = _RouterRequest(
+            rid=rid, prompt=prompt, kw=kw, rng_key=rng_key,
+            on_token=on_token, submit_time=now, queued_since=now,
+            queue_ttl_s=float(queue_ttl_s if queue_ttl_s is not None
+                              else self.queue_ttl_s),
+            deadline_s=float(deadline_s if deadline_s is not None
+                             else self.deadline_s),
+            affinity_key=self._affinity_key(prompt),
+        )
+        self._requests[rid] = req
+        self._queue.append(req)
+        return rid
+
+    def _affinity_key(self, prompt: np.ndarray) -> Optional[int]:
+        """Hash of the longest FULL-page prompt prefix (None when
+        affinity is off, the fleet is unpaged, or no page fills): the
+        page is the trie-sharing granularity, so this is exactly the
+        prefix whose warm pages a previous session may have parked."""
+        if not self.affinity or not self._affinity_page:
+            return None
+        n = (prompt.size // self._affinity_page) * self._affinity_page
+        if n == 0:
+            return None
+        return zlib.crc32(np.ascontiguousarray(prompt[:n]).tobytes())
+
+    # -------------------------------------------------------------- step
+
+    def step(self) -> Dict:
+        """One router tick: shed expired queued work, probe due replicas
+        (rotate out / escalate / rejoin), dispatch the queue, tick every
+        live replica once (collecting results and handling death), and
+        strand the remainder loudly if the whole fleet is gone. Returns
+        a summary dict."""
+        self._ticks += 1
+        now = self._now()
+        shed = self._shed_expired(now)
+        self._probe_due()
+        dispatched = self._dispatch()
+        finished, migrated = self._tick_replicas()
+        stranded = self._strand_if_no_replicas()
+        in_rotation = sum(r.state == ReplicaState.OK for r in self._replicas)
+        self.metrics.observe_tick(len(self._queue), len(self._replicas),
+                                  in_rotation)
+        return {"dispatched": dispatched, "finished": finished,
+                "migrated": migrated, "shed": shed + stranded,
+                "queue_depth": len(self._queue),
+                "in_rotation": in_rotation,
+                "replica_states": [r.state for r in self._replicas]}
+
+    def drain(self, max_ticks: Optional[int] = None
+              ) -> Dict[int, ServingResult]:
+        """Tick until every submitted request has its terminal result
+        (or ``max_ticks``), then return-and-clear the finished results."""
+        n = 0
+        while any(r.state != "finished" for r in self._requests.values()):
+            self.step()
+            n += 1
+            if max_ticks is not None and n >= max_ticks:
+                break
+        out, self._results = self._results, {}
+        for rid in out:
+            self._requests.pop(rid, None)
+        return out
+
+    def result(self, request_id: int) -> Optional[ServingResult]:
+        """Finished result for ``request_id`` (None while in flight)."""
+        return self._results.get(request_id)
+
+    def take_result(self, request_id: int) -> Optional[ServingResult]:
+        """Remove and return one finished result (None while in flight)."""
+        res = self._results.pop(request_id, None)
+        if res is not None:
+            self._requests.pop(request_id, None)
+        return res
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a queued or dispatched request (exactly one terminal
+        result with ``finish_reason="cancelled"``, partial tokens kept).
+        False when unknown or already finished."""
+        req = self._requests.get(request_id)
+        if req is None or req.state == "finished":
+            return False
+        if req.state == "dispatched":
+            rep = self._replicas[req.replica]
+            rep.dispatched.pop(req.engine_rid, None)
+            if rep.state not in (ReplicaState.DEAD,):
+                try:
+                    rep.engine.cancel(req.engine_rid)
+                    rep.engine.take_result(req.engine_rid)  # drop the copy
+                except Exception:  # noqa: BLE001 — a dying replica is fine
+                    pass
+        else:
+            self._queue = [r for r in self._queue if r.rid != request_id]
+        self._finalize(req, "cancelled")
+        obs_emit("request_cancelled", request=request_id,
+                 router=self.metrics.router_label)
+        return True
+
+    def shutdown(self, grace_s: Optional[float] = None
+                 ) -> Dict[int, ServingResult]:
+        """Graceful cluster drain: stop router admission, ask every live
+        replica to drain (``request_shutdown``), tick until every request
+        has its terminal result (replicas retire leftovers at their grace
+        deadline), finalize still-queued requests as ``"shutdown"``, and
+        return-and-clear all results."""
+        self._shutting_down = True
+        for rep in self._replicas:
+            if rep.state != ReplicaState.DEAD:
+                try:
+                    rep.engine.request_shutdown(grace_s)
+                except Exception:  # noqa: BLE001 — best-effort on a zombie
+                    pass
+        while any(r.state == "dispatched" for r in self._requests.values()):
+            self.step()
+        for req in list(self._queue):
+            self._finalize(req, "shutdown")
+        self._queue = []
+        out, self._results = self._results, {}
+        for rid in out:
+            self._requests.pop(rid, None)
+        return out
+
+    # --------------------------------------------------------- internals
+
+    def _shed_expired(self, now: float) -> int:
+        """Deadline-aware shedding of the ROUTER queue: queued requests
+        past their queue-TTL or total deadline finalize as ``"timeout"``
+        (migrated partials kept) instead of occupying queue slots they
+        can no longer use."""
+        shed = 0
+        keep = []
+        for req in self._queue:
+            waiting = now - req.queued_since   # THIS queue residency
+            age = now - req.submit_time        # total lifetime
+            if ((req.queue_ttl_s and waiting > req.queue_ttl_s)
+                    or (req.deadline_s and age > req.deadline_s)):
+                self._finalize(req, "timeout")
+                obs_emit("request_timeout", request=req.rid,
+                         where="router_queue")
+                self.metrics.record_shed()
+                shed += 1
+            else:
+                keep.append(req)
+        self._queue = keep
+        return shed
+
+    def _probe(self, rep: _Replica) -> Dict:
+        """One health probe: the flap injector may LIE, otherwise the
+        replica's ``health()`` report (== its ``/healthz`` body); a
+        raising probe reads as dead."""
+        lie = faults.on_router_probe(rep.index)
+        if lie is not None:
+            return lie
+        try:
+            return rep.engine.health()
+        except Exception as e:  # noqa: BLE001 — unreachable replica
+            return {"state": "dead", "error": f"{type(e).__name__}: {e}"}
+
+    def _probe_due(self) -> None:
+        """Probe replicas whose schedule is due: healthy/draining ones on
+        the ``probe_every`` cadence, suspects on their bounded-backoff
+        schedule. State transitions per the module docstring."""
+        for rep in self._replicas:
+            if rep.state == ReplicaState.DEAD:
+                continue
+            if rep.state == ReplicaState.SUSPECT:
+                if self._ticks < rep.next_probe_tick:
+                    continue
+            elif (self._ticks - 1) % self.probe_every:
+                continue
+            report = self._probe(rep)
+            state = report.get("state", "dead")
+            if state == "ok":
+                if rep.state == ReplicaState.SUSPECT:
+                    self._rejoin(rep)
+                rep.probe_failures = 0
+            elif state == "draining":
+                # the replica is finishing its own work: no dispatches,
+                # keep ticking, never escalate to dead on this signal.
+                # A SUSPECT turning draining must first cancel its
+                # hedged-away stale copies — draining replicas ARE
+                # ticked, and a stale copy decoding there would
+                # double-deliver tokens the migrated copy owns
+                if rep.state != ReplicaState.DRAINING:
+                    self._cancel_stale(rep)
+                    rep.state = ReplicaState.DRAINING
+                    obs_emit("replica_out", replica=rep.index,
+                             reason="draining")
+                    logger.warning(
+                        "router: replica %d rotated out (draining)",
+                        rep.index)
+            else:  # dead / unreachable
+                rep.probe_failures += 1
+                self.metrics.record_probe_failure()
+                if rep.probe_failures >= self.probe_max_failures:
+                    self._mark_dead(rep, f"probe escalation "
+                                    f"({rep.probe_failures} failures)")
+                    continue
+                backoff = (self.probe_backoff_ticks
+                           * (2 ** (rep.probe_failures - 1)))
+                rep.next_probe_tick = self._ticks + min(backoff, 64)
+                if rep.state == ReplicaState.OK:
+                    rep.state = ReplicaState.SUSPECT
+                    obs_emit("replica_out", replica=rep.index,
+                             reason=state,
+                             probe_failures=rep.probe_failures)
+                    logger.warning(
+                        "router: replica %d rotated out (probe says %r); "
+                        "re-probing with backoff before declaring it dead",
+                        rep.index, state)
+                    if self.hedge:
+                        # hedged re-dispatch: do not wait out the probe
+                        # escalation — move its work to survivors now and
+                        # cancel the stale copies if it ever rejoins
+                        self._migrate_all(rep, why="hedge", stale=True)
+
+    def _cancel_stale(self, rep: _Replica) -> None:
+        """Cancel and drop the engine-side copies of requests hedged
+        away while ``rep`` was suspect — exactly-one-stream: the
+        migrated copy is the live one, so before this engine is ever
+        ticked again (rejoin OR drain) its stale copies must die."""
+        for erid in rep.stale:
+            try:
+                rep.engine.cancel(erid)
+                rep.engine.take_result(erid)  # drop the cancelled copy
+            except Exception:  # noqa: BLE001
+                pass
+        rep.stale = []
+
+    def _rejoin(self, rep: _Replica) -> None:
+        """A suspect proved healthy: cancel the engine-side copies of
+        hedged-away requests (exactly-one-result: the migrated copy is
+        the live one), then put the replica back in rotation."""
+        self._cancel_stale(rep)
+        rep.state = ReplicaState.OK
+        rep.probe_failures = 0
+        obs_emit("replica_back", replica=rep.index)
+        logger.warning("router: replica %d back in rotation", rep.index)
+
+    def _mark_dead(self, rep: _Replica, reason: str) -> None:
+        """Point of no return for one replica: declare it dead, migrate
+        everything it still held, drop its affinity pins."""
+        if rep.state == ReplicaState.DEAD:
+            return
+        rep.state = ReplicaState.DEAD
+        try:
+            rep.engine.declare_dead()
+        except Exception:  # noqa: BLE001 — the process may be gone
+            pass
+        self.metrics.record_replica_death()
+        obs_emit("replica_dead", replica=rep.index, reason=reason)
+        logger.error("router: replica %d is DEAD (%s); migrating %d "
+                     "in-flight request(s)", rep.index, reason,
+                     len(rep.dispatched))
+        self._migrate_all(rep, why="replica_dead")
+        self._affinity_map = {k: v for k, v in self._affinity_map.items()
+                              if v != rep.index}
+
+    def _migrate_all(self, rep: _Replica, *, why: str,
+                     stale: bool = False) -> int:
+        """Re-queue every request dispatched to ``rep`` at the router
+        queue HEAD in submission order, each carrying its durable token
+        history for the admit-with-history re-dispatch. ``stale`` tracks
+        the engine-side rids for cancel-on-rejoin (hedging)."""
+        moved = []
+        for erid, rid in sorted(rep.dispatched.items(), key=lambda kv: kv[1]):
+            req = self._requests[rid]
+            if req.state != "dispatched":
+                continue
+            req.state = "queued"
+            req.replica = None
+            req.engine_rid = None
+            req.queued_since = self._now()  # fresh TTL clock (re-queue)
+            moved.append(req)
+            if stale:
+                rep.stale.append(erid)
+            self.metrics.record_migrated()
+            obs_emit("request_migrated", request=rid, replica=rep.index,
+                     tokens=len(req.tokens), why=why)
+        rep.dispatched = {}
+        self._queue = moved + self._queue
+        return len(moved)
+
+    def _load(self, rep: _Replica) -> float:
+        """Dispatch load score: what the health report prices — queued +
+        active work (a cross-process router uses its cached probe). A
+        raising ``health()`` between probes scores infinitely loaded —
+        least preferred but never a router-wide crash; the next probe
+        rotates the replica out properly."""
+        try:
+            h = rep.engine.health()
+        except Exception:  # noqa: BLE001 — sickness is the probe's call
+            return float("inf")
+        return int(h.get("queue_depth", 0)) + int(h.get("active", 0))
+
+    def _pick_replica(self, req: _RouterRequest, exclude, loads):
+        """Placement: ``(replica, via_affinity)`` — prefix affinity
+        first (the replica whose warm trie owns this prompt's full-page
+        prefix), falling back to least-loaded when the owner is rotated
+        out, excluded, or unknown; ``(None, False)`` when no replica is
+        in rotation (the queue waits). ``loads`` is this tick's score
+        memo (one ``health()`` read per replica per tick, bumped per
+        dispatch — the in-process version of scoring from the cached
+        probe scrape)."""
+        candidates = [r for r in self._replicas
+                      if r.state == ReplicaState.OK
+                      and r.index not in exclude]
+        if not candidates:
+            return None, False
+        if req.affinity_key is not None:
+            owner = self._affinity_map.get(req.affinity_key)
+            for r in candidates:
+                if r.index == owner:
+                    return r, True
+        return min(candidates,
+                   key=lambda r: (loads.get(r.index, 0), r.index)), False
+
+    def _dispatch(self) -> int:
+        """FIFO dispatch of the router queue onto in-rotation replicas;
+        a request whose every candidate rejects (queue full/draining)
+        stays queued in arrival order."""
+        dispatched = 0
+        blocked = False
+        remaining: List[_RouterRequest] = []
+        loads = {r.index: self._load(r) for r in self._replicas
+                 if r.state == ReplicaState.OK}
+        for req in self._queue:
+            if blocked:  # preserve FIFO order past the first stuck head
+                remaining.append(req)
+                continue
+            if not self._dispatch_one(req, loads):
+                remaining.append(req)
+                blocked = req.state == "queued"
+            else:
+                dispatched += 1
+        self._queue = [r for r in remaining if r.state == "queued"]
+        return dispatched
+
+    def _dispatch_one(self, req: _RouterRequest, loads) -> bool:
+        """Try to place one request; True iff it was dispatched (a
+        terminal finalize — dead fleet, bad deadline — returns False but
+        leaves ``req.state`` finished, so the caller drops it)."""
+        exclude = set()
+        refused = None     # last ValueError across candidates
+        only_refusals = True  # no candidate was merely full/draining
+        while True:
+            rep, via_affinity = self._pick_replica(req, exclude, loads)
+            if rep is None:
+                if refused is not None and only_refusals and exclude:
+                    # EVERY in-rotation replica judged the request
+                    # inadmissible (not full — invalid): exactly one
+                    # terminal result, loudly, as an error. If any
+                    # candidate was merely full, the request WAITS —
+                    # capacity may free up.
+                    logger.error(
+                        "router: request %d rejected by every replica "
+                        "(%s); finalizing as error", req.rid, refused)
+                    self._finalize(req, "error")
+                return False
+            kw = dict(req.kw)
+            if req.deadline_s:
+                remaining = req.deadline_s - (self._now() - req.submit_time)
+                if remaining <= 0:
+                    self._finalize(req, "timeout")
+                    obs_emit("request_timeout", request=req.rid,
+                             where="router_dispatch")
+                    self.metrics.record_shed()
+                    return False
+                # forward the REMAINING budget so the global deadline
+                # holds across queue time and migrations
+                kw["deadline_s"] = remaining
+            try:
+                erid = rep.engine.submit(
+                    req.prompt, on_token=self._make_cb(req),
+                    rng_key=req.rng_key,
+                    history=req.tokens if req.tokens else None, **kw)
+            except QueueFull:
+                only_refusals = False
+                exclude.add(rep.index)
+                continue
+            except ShuttingDown:
+                rep.state = ReplicaState.DRAINING
+                obs_emit("replica_out", replica=rep.index,
+                         reason="draining")
+                only_refusals = False
+                exclude.add(rep.index)
+                continue
+            except ValueError as e:
+                # THIS replica can't legally admit it (e.g. a smaller
+                # survivor whose budget a migrated history exceeds on a
+                # heterogeneous fleet) — try the others before giving up
+                refused = e
+                exclude.add(rep.index)
+                continue
+            req.state = "dispatched"
+            req.replica = rep.index
+            req.engine_rid = erid
+            req.dispatches += 1
+            loads[rep.index] = loads.get(rep.index, 0) + 1
+            rep.dispatched[erid] = req.rid
+            if req.affinity_key is not None:
+                self._affinity_map.setdefault(req.affinity_key, rep.index)
+                # bounded pin table: the warm caches the pins point at
+                # are themselves LRU, so dropping the OLDEST pin only
+                # costs a likely-already-cold locality hint — never
+                # correctness — and the router's memory stays constant
+                # under millions of distinct prefixes
+                while len(self._affinity_map) > self._AFFINITY_CAP:
+                    self._affinity_map.pop(next(iter(self._affinity_map)))
+            self.metrics.record_dispatch(via_affinity)
+            return True
+
+    def _make_cb(self, req: _RouterRequest):
+        """Per-dispatch ``on_token`` wrapper: append to the router's
+        durable history (the failover replay source), record TTFT, and
+        forward to the user's callback under the ROUTER request id."""
+        def cb(_engine_rid, tok, finished):
+            req.tokens.append(int(tok))
+            if req.first_token_time is None:
+                req.first_token_time = self._now()
+                self.metrics.observe_ttft(
+                    req.first_token_time - req.submit_time)
+            if req.on_token is not None:
+                req.on_token(req.rid, int(tok), bool(finished))
+        return cb
+
+    def _tick_replicas(self):
+        """Tick every live replica once: the kill injector and
+        ``RecoveryExhausted`` feed the dead path; a recovered tick
+        re-bases request histories from engine host truth; finished
+        engine results finalize their router requests."""
+        finished = migrated = 0
+        for rep in self._replicas:
+            if rep.state in (ReplicaState.DEAD, ReplicaState.SUSPECT):
+                continue  # suspects are not ticked (partition semantics)
+            try:
+                faults.on_router_tick(rep.index, self._ticks)
+                with span("router.tick_replica", replica=rep.index):
+                    summary = rep.engine.step()
+            except ReplicaKilled as e:
+                migrated += len(rep.dispatched)
+                self._mark_dead(rep, str(e))
+                continue
+            except RecoveryExhausted as e:
+                migrated += len(rep.dispatched)
+                self._mark_dead(rep, f"RecoveryExhausted: {e}")
+                continue
+            if summary.get("recovered"):
+                # in-place recovery rolled host truth back: re-base the
+                # durable histories on it (stream-offset re-sync)
+                for erid, rid in rep.dispatched.items():
+                    toks = rep.engine.emitted_tokens(erid)
+                    if toks is not None:
+                        self._requests[rid].tokens = list(toks)
+            finished += self._collect(rep)
+        return finished, migrated
+
+    def _collect(self, rep: _Replica) -> int:
+        """Pull finished engine results for this replica's dispatches and
+        finalize them (exactly once — the dispatched-map entry is the
+        single path from engine result to router result)."""
+        done = 0
+        continued = []
+        for erid in list(rep.dispatched):
+            res = rep.engine.take_result(erid)
+            if res is None:
+                continue
+            rid = rep.dispatched.pop(erid)
+            req = self._requests[rid]
+            req.tokens = [int(t) for t in res.tokens]
+            if (res.finish_reason == "shutdown" and not self._shutting_down
+                    and any(r.state == ReplicaState.OK
+                            for r in self._replicas)):
+                # an externally-draining replica ran out of grace with
+                # this request unfinished: its partial tokens are all
+                # delivered, so CONTINUE it on a survivor instead of
+                # surfacing a truncated result
+                req.state = "queued"
+                req.replica = None
+                req.engine_rid = None
+                req.queued_since = self._now()
+                continued.append(req)
+                self.metrics.record_migrated()
+                obs_emit("request_migrated", request=rid,
+                         replica=rep.index, tokens=len(req.tokens),
+                         why="drain_expired")
+                continue
+            self._finalize(req, res.finish_reason)
+            done += 1
+        if continued:
+            # one prepend in submission order — the same head-of-queue
+            # FIFO fairness _migrate_all gives dead-replica migrations
+            continued.sort(key=lambda r: r.rid)
+            self._queue = continued + self._queue
+        return done
+
+    def _strand_if_no_replicas(self) -> int:
+        """Lost-fleet backstop — ``drain()`` must terminate, not hang:
+
+        - every replica dead → everything left finalizes as ``"error"``
+          with a ``router_stranded`` event (the operator lost the fleet);
+        - every replica dead OR draining → nothing will ever accept a
+          dispatch again, so QUEUED requests finalize as ``"shutdown"``
+          (dispatched ones keep ticking — their draining replicas retire
+          them under the engine grace window).
+
+        A suspect replica blocks both: it may rejoin."""
+        states = {r.state for r in self._replicas}
+        if states & {ReplicaState.OK, ReplicaState.SUSPECT}:
+            return 0
+        all_dead = states == {ReplicaState.DEAD}
+        stranded = 0
+        for req in list(self._queue):
+            self._finalize(req, "error" if all_dead else "shutdown")
+            stranded += 1
+        self._queue = []
+        if all_dead:
+            for req in self._requests.values():
+                if req.state == "dispatched":  # died with their replicas
+                    self._finalize(req, "error")
+                    stranded += 1
+            if stranded:
+                obs_emit("router_stranded", requests=stranded,
+                         router=self.metrics.router_label)
+                logger.error(
+                    "router: every replica is dead; %d request(s) "
+                    "stranded with finish_reason='error'", stranded)
+        return stranded
+
+    def _finalize(self, req: _RouterRequest, reason: str) -> None:
+        """Record THE terminal result for one request (idempotent — the
+        exactly-one-result invariant's last line of defense)."""
+        if req.state == "finished":
+            return
+        req.state = "finished"
+        now = self._now()
+        self._results[req.rid] = ServingResult(
+            id=req.rid, prompt=req.prompt,
+            tokens=np.asarray(req.tokens, np.int32),
+            finish_reason=reason,
+            ttft_s=(req.first_token_time or now) - req.submit_time,
+            latency_s=now - req.submit_time,
+        )
+        self.metrics.record_finished(reason, now - req.submit_time)
+
+    # ---------------------------------------------------------- introspection
+
+    @property
+    def replica_states(self) -> List[str]:
+        """Per-replica lifecycle state, by index."""
+        return [r.state for r in self._replicas]
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting in the router queue."""
+        return len(self._queue)
+
+    @property
+    def in_flight(self) -> int:
+        """Requests currently dispatched to a replica."""
+        return sum(r.state == "dispatched" for r in self._requests.values())

@@ -179,6 +179,10 @@ def test_left_padded_prompt_parity(monkeypatch, model_and_params):
     np.testing.assert_array_equal(dense, flash)
 
 
+@pytest.mark.slow  # 6.4s (PR 15 tier-1 budget audit): flash-vs-dense
+# decode parity stays tier-1 via the greedy/sampling/left-padded gates
+# above; beam semantics stay tier-1 in test_beam_search.py (beam's
+# flash variant re-runs with the slow-marked beam left-pad parity)
 def test_beam_search_parity_flash_vs_dense(monkeypatch, model_and_params):
     """beam_search() rides the same model decode branch — free fast path."""
     model, params = model_and_params

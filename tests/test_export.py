@@ -184,6 +184,10 @@ def test_engine_generate_mesh_sharded(gen_engine_factory, eight_devices):
     assert engine._serving.mesh is mp2  # the delegate engine IS meshed
 
 
+@pytest.mark.slow  # 5.5s (PR 15 tier-1 budget audit): the delegation
+# policy's core contract (servable calls delegate, byte-identical)
+# stays tier-1 via test_engine_generate_delegates_to_serving; this
+# guards the too-small-cache fallback branch of the same policy switch
 def test_engine_small_serving_cache_falls_back_one_shot(gen_engine_factory,
                                                         monkeypatch):
     """A FLEETX_SERVING_CACHE_LEN too small for the request must fall back

@@ -312,6 +312,9 @@ def test_eval_module_scoring(tmp_path):
     assert "ppl" in result and np.isfinite(result["ppl"]) and result["ppl"] > 1
 
 
+@pytest.mark.slow  # 4.2s (PR 15 tier-1 budget audit): the left-pad
+# contract stays tier-1 via test_left_padded_batch_matches_unpadded
+# (the batch variant subsumes the single-prompt case)
 def test_left_padded_prompt_matches_unpadded(model_and_params):
     """A left-padded prompt row with attention_mask must decode the SAME
     continuation as the unpadded prompt: pad slots are never attended and
@@ -339,6 +342,10 @@ def test_left_padded_prompt_matches_unpadded(model_and_params):
     np.testing.assert_array_equal(cont_plain, cont_padded)
 
 
+@pytest.mark.slow  # 4.9s (PR 15 tier-1 budget audit): per-row
+# independence is the serving parity suites' tier-1 backbone (staggered
+# admissions vs one-shot, test_serving/test_paged_serving) and the
+# left-pad batch gate above stays tier-1
 def test_mixed_padding_batch_rows_independent(model_and_params):
     """Rows with different left-pad counts in ONE batch must each decode
     what they decode alone (no cross-row leakage through pad slots)."""

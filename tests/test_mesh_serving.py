@@ -179,6 +179,9 @@ def test_mesh_recover_rebuilds_sharded_state(model_and_params, mp2):
     assert eng.cache_manager.cache_nbytes() < 0.55 * single_bytes
 
 
+@pytest.mark.slow  # 5.5s (PR 15 tier-1 budget audit): the mesh parity
+# contract stays tier-1 via the paged (default-layout) gate above; the
+# slot x mesh combination re-runs in the slow matrix
 def test_mesh_slot_path_parity(model_and_params, mp2):
     """The slot cache layout shards heads-over-mp too: byte parity vs the
     single-device slot engine, with per-request overrides riding along

@@ -330,6 +330,75 @@ def test_serving_metrics_series_removed_on_gc():
     assert not leftover, leftover
 
 
+def test_healthz_json_body_carries_rotate_out_reason():
+    """ISSUE 15 satellite: /healthz responses carry a small JSON body —
+    state (ok/draining/dead), queue depth, active count — so the router
+    and any external LB get a rotate-out REASON, not just 200/503. The
+    in-process ServingEngine.health() dict IS the HTTP body's detail."""
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+
+    from fleetx_tpu.models.gpt.generation import GenerationConfig
+    from fleetx_tpu.models.gpt.model import GPTConfig, GPTForPretraining
+    from fleetx_tpu.serving import ServingEngine
+
+    # engines are cyclic garbage (their jits close over self), so a
+    # previous test's shut-down engine may still hold a draining probe
+    # until the generational GC runs — force it so /healthz starts clean
+    gc.collect()
+
+    cfg = GPTConfig(
+        vocab_size=61, hidden_size=32, num_layers=1, num_attention_heads=2,
+        ffn_hidden_size=64, max_position_embeddings=32,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        dtype=jnp.float32, use_flash_attention=False)
+    model = GPTForPretraining(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))
+    eng = ServingEngine(
+        model, params, slots=2, cache_len=16, prefill_bucket=4,
+        gen_cfg=GenerationConfig(decode_strategy="greedy",
+                                 eos_token_id=10**6, pad_token_id=60,
+                                 max_length=4))
+    assert eng.health() == {"state": "ok", "queue_depth": 0, "active": 0,
+                            "slots": 2}
+    eng.submit(np.asarray([1, 2, 3], np.int32), max_length=4)
+    assert eng.health()["queue_depth"] == 1
+    srv = ObsServer(port=0).start()
+    try:
+        status, body = _get(srv.url + "/healthz")
+        payload = json.loads(body)
+        assert payload["state"] == "ok"
+        assert payload["queue_depth"] == 1 and payload["active"] == 0
+        detail = payload["detail"][eng._health_name]
+        assert detail == eng.health()
+        # draining: 503 with the REASON in the body
+        eng.request_shutdown(grace_s=30.0)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(srv.url + "/healthz")
+        assert exc.value.code == 503
+        payload = json.loads(exc.value.read())
+        assert payload["state"] == "draining"
+        assert payload["detail"][eng._health_name]["state"] == "draining"
+        # dead beats draining in the aggregate
+        eng.declare_dead()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(srv.url + "/healthz")
+        assert json.loads(exc.value.read())["state"] == "dead"
+        # /snapshot mirrors the same health detail
+        eng._dead = False
+        eng._shutting_down = False
+        _, body = _get(srv.url + "/snapshot")
+        health = json.loads(body)["health"]
+        assert health["state"] == "ok"
+        assert health["detail"][eng._health_name]["state"] == "ok"
+    finally:
+        srv.stop()
+        eng.shutdown(grace_s=0.0)
+        unregister_health(eng._health_name)  # don't leak a 503 to later tests
+
+
 def test_healthz_fails_after_recovery_exhausted():
     """A replica that died with RecoveryExhausted must report unhealthy —
     the router must stop routing to it even though it never drained."""

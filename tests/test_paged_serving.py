@@ -300,6 +300,82 @@ def test_host_store_byte_budget_rejects_and_evicts():
     store.check_invariants()
 
 
+def test_host_store_payload_bytes_roundtrip():
+    """ISSUE 15 satellite: the pickle-free ``to_bytes``/``from_bytes``
+    wire format round-trips a spill payload BYTE-EXACTLY — K/V leaves,
+    int8 value pages, their fp32 scale leaves, bf16 leaves, and the None
+    slots of rank-<4 cache leaves — and corrupt input fails loudly. This
+    is the page-ship primitive the cross-replica prefill/decode split
+    serializes over the wire (ROADMAP item 2)."""
+    import ml_dtypes
+
+    rng = np.random.RandomState(9)
+    payload = [
+        rng.randn(8, 2, 64).astype(np.float32),          # K page
+        None,                                            # cache_index slot
+        rng.randint(-128, 128, (8, 2, 64)).astype(np.int8),  # int8 V page
+        rng.randn(8, 2, 1).astype(np.float32),           # int8 scale leaf
+        rng.randn(4, 2, 8).astype(ml_dtypes.bfloat16),   # bf16 page
+    ]
+    buf = HostPageStore.payload_to_bytes(payload)
+    assert isinstance(buf, bytes) and buf[:4] == b"FXPG"
+    back = HostPageStore.payload_from_bytes(buf)
+    assert len(back) == len(payload)
+    assert back[1] is None
+    for want, got in zip(payload, back):
+        if want is None:
+            continue
+        assert got.dtype == want.dtype and got.shape == want.shape
+        assert got.tobytes() == want.tobytes(), "not byte-exact"
+    # the round-trip of the round-trip is stable (canonical form)
+    assert HostPageStore.payload_to_bytes(back) == buf
+    # corruption fails loudly — always as ValueError, wherever the
+    # truncation lands (mid-array, right after the header, or inside a
+    # dtype name) — never revives garbage K/V
+    with pytest.raises(ValueError):
+        HostPageStore.payload_from_bytes(buf[:-5])
+    with pytest.raises(ValueError):
+        HostPageStore.payload_from_bytes(buf[:8])
+    with pytest.raises(ValueError):
+        HostPageStore.payload_from_bytes(buf[:12])
+    with pytest.raises(ValueError):
+        HostPageStore.payload_from_bytes(buf + b"xx")
+    with pytest.raises(ValueError):
+        HostPageStore.payload_from_bytes(b"NOPE" + buf[4:])
+    # a REAL spilled payload (engine path) round-trips too: grab one via
+    # the manager's spill_fn on a live paged cache
+    from fleetx_tpu.serving import ServingEngine
+    from fleetx_tpu.models.gpt.generation import GenerationConfig
+    from fleetx_tpu.models.gpt.model import GPTConfig, GPTForPretraining
+
+    cfg = GPTConfig(
+        vocab_size=61, hidden_size=32, num_layers=1, num_attention_heads=2,
+        ffn_hidden_size=64, max_position_embeddings=32,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        dtype=jnp.float32, use_flash_attention=False)
+    model = GPTForPretraining(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))
+    eng = ServingEngine(
+        model, params, slots=1, cache_len=16, prefill_bucket=4,
+        paged=True, page_size=8,
+        gen_cfg=GenerationConfig(decode_strategy="greedy",
+                                 eos_token_id=10**6, pad_token_id=60,
+                                 max_length=2))
+    rid = eng.submit(np.arange(1, 10, dtype=np.int32), max_length=2)
+    eng.drain()
+    (real, nbytes), = eng.cache_manager._spill_pages([1])
+    buf = HostPageStore.payload_to_bytes(real)
+    back = HostPageStore.payload_from_bytes(buf)
+    for want, got in zip(real, back):
+        if want is None:
+            assert got is None
+        else:
+            assert got.dtype == want.dtype
+            assert got.tobytes() == want.tobytes()
+    assert sum(a.nbytes for a in back if a is not None) == nbytes
+    del rid
+
+
 def test_pagepool_share_revive_evict_exact():
     """Deterministic lifecycle: two lanes share a 2-page prefix (refcount
     2), frees park registered pages in the warm cache, a third alloc

@@ -130,6 +130,10 @@ def test_manual_recover_is_byte_identical(tiny, paged):
         assert_token_parity(clean[i], np.asarray(res[r].tokens))
 
 
+@pytest.mark.slow  # 5.3s (PR 15 tier-1 budget audit): the one-split-
+# per-emitted-token RNG reconstruction stays tier-1 via test_router.py
+# test_submit_with_history_sampling_rng_position_exact (the same
+# _replay seam, sampling byte-parity) and the spec rng gates
 def test_sampling_replay_reconstructs_rng_stream(tiny):
     """Replay recovery reconstructs each sampling request's PRNG position
     (one split at admit, one per decode tick), so post-recovery draws

@@ -95,7 +95,13 @@ def _serve(model, params, prompts, max_length=MAX_NEW, submit_kw=None,
 
 # ------------------------------------------------- tier-1 byte-parity gates
 
-@pytest.mark.parametrize("paged", [False, True], ids=["slot", "paged"])
+@pytest.mark.parametrize("paged", [
+    # slot 6.4s -> slow (PR 15 tier-1 budget audit): the paged default
+    # layout keeps the tier-1 spec byte-parity gate; slot x spec re-runs
+    # in the slow matrix
+    pytest.param(False, id="slot", marks=pytest.mark.slow),
+    pytest.param(True, id="paged"),
+])
 def test_spec_greedy_byte_parity(model_and_params, prompts, paged):
     """THE gate: speculative greedy streams are byte-identical to the
     non-speculative engine on both storage layouts, and the engine

@@ -100,11 +100,15 @@ def test_bench_serving_records_schema(monkeypatch):
     if has_mesh:
         want.append("gpt_345m_serving_mesh")
     want.append("gpt_345m_serving_page_sweep")
+    want.append("gpt_345m_serving_router_slo")
     assert [r["metric"] for r in recs] == want
     static, cont, shared, faulted, int8, chunked, spec = recs[:7]
     mesh = recs[7] if has_mesh else None
-    sweep = recs[-1]
+    sweep = recs[-2]
+    router = recs[-1]
     for r in recs:
+        if r["metric"] == "gpt_345m_serving_router_slo":
+            continue  # a goodput fraction, asserted separately below
         assert r["unit"] == "tokens/s"
         assert np.isfinite(r["value"]) and r["value"] > 0
         d = r["detail"]
@@ -206,6 +210,25 @@ def test_bench_serving_records_schema(monkeypatch):
     assert [s["page_size"] for s in d["sweep"]] == [8]
     assert d["best_page_size"] == 8
     assert all(s["tokens_per_s"] > 0 for s in d["sweep"])
+    # the multi-replica SLO record (docs/SERVING.md "Multi-replica
+    # router"): at-saturation everything completes (goodput is the
+    # record's value), past-saturation the router sheds but never
+    # collapses, both passes name their seeded workload hash — the
+    # regression gate compares like against like
+    assert router["unit"] == "goodput_frac"
+    assert router["value"] == router["detail"]["at"]["goodput"]
+    d = router["detail"]
+    assert d["n_replicas"] == 2 and d["replica_slots"] == 2
+    assert len(d["workload_hash_at"]) == 16
+    assert len(d["workload_hash_past"]) == 16
+    at, past = d["at"], d["past"]
+    assert at["requests"] == past["requests"] == d["requests"]
+    assert at["completed_frac"] == 1.0 and 0 < at["goodput"] <= 1
+    assert at["ttft_ms_p50"] > 0 and at["ttft_ms_p99"] >= at["ttft_ms_p50"]
+    assert past["shed_frac"] > 0 and past["completed_frac"] > 0
+    assert set(past["finish_reasons"]) <= {
+        "eos", "max_length", "timeout", "rejected", "cache_full"}
+    assert set(at["goodput_per_tenant"]) <= {"chat", "template"}
 
 
 def test_pp_bubble_records_schema(monkeypatch, tmp_path):
@@ -358,6 +381,26 @@ def test_chaos_check_serving_spill_scenario(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 0, out
     assert "PASS serving_spill" in out
+
+
+@pytest.mark.slow  # ~35s; tier-1 covers the same contracts via
+def test_chaos_check_router_scenarios(tmp_path, capsys):
+    # tests/test_router.py (kill-failover byte parity, conservation
+    # churn, saturation shedding); this proves the CLI driver end-to-end
+    """The multi-replica router chaos scenarios — a replica killed
+    mid-burst (zero-token-loss migration, byte parity, replica_dead +
+    request_migrated events, goodput shows no lost requests) and
+    past-saturation degradation (rejects + sheds, exactly one terminal
+    result each, router alive after) — pass through the CLI driver."""
+    sys.path.insert(0, REPO)
+    import tools.chaos_check as cc
+
+    rc = cc.main(["--only", "router_kill,router_saturation",
+                  "--workdir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "PASS router_kill" in out
+    assert "PASS router_saturation" in out
 
 
 def test_obs_dump_scrapes_live_server(tmp_path):

@@ -265,6 +265,10 @@ def test_overlap_flags_env_logic():
     assert "=false" in env["XLA_FLAGS"].split()[0]
 
 
+@pytest.mark.slow  # 9.4s (PR 15 tier-1 budget audit): a perf-hygiene
+# unit (memoized relowering), not output correctness — a regression
+# shows up as per-window slowdown in the bench/mfu trajectory, and the
+# gauges it feeds are asserted tier-1 in test_trainer's TRAIN-line test
 def test_cost_analysis_cached_per_signature(tmp_path, monkeypatch):
     """Trainer.cost_analysis memoizes per compiled-step signature: the
     per-step mfu/hbm gauges must query the (cache-hit but still ms-cost)

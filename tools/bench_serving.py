@@ -72,6 +72,20 @@ single-device run ASSERTED, per-device `fleetx_serving_kv_cache_bytes`
 in `detail.mesh`. Skipped (no record) below 2 devices or when the heads
 don't divide.
 
+A ninth record (`router_slo`) banks the MULTI-REPLICA SLO goodput story
+(docs/SERVING.md "Multi-replica router", ROADMAP item 5): a seeded
+deterministic trace (Poisson arrivals, two tenants — one sharing a
+system prefix — `serving/workload.py`) replays against a
+`ServingRouter` over N warmed replicas twice: AT saturation (the fleet
+keeps up; every request completes — asserted) and PAST saturation
+(arrivals several times the fleet's capacity against a bounded router
+queue + queue TTL; the router degrades gracefully — rejects/timeouts
+shed load, the survivors complete, nothing is lost or duplicated —
+asserted). `value` is the at-saturation goodput fraction; `detail`
+carries both passes' full scores (goodput, TTFT/TPOT p50/p99,
+finish-reason mix, per-tenant goodput) and the seeded workload hashes,
+so a regression gate can compare like against like.
+
 `BENCH_SERVING_PAGE_SIZES=16,32,64` appends a page-size sweep record
 (`page_sweep`): the continuous workload re-run per page size so a TPU
 window can pick a DMA-tuned default over the correctness-tuned 16
@@ -301,6 +315,103 @@ def _spill_report(model, variables, gen_cfg, slots):
         "host_revived_pages": on_snap["host_revived_pages"],
         "host_evicted_pages": on_snap["host_evicted_pages"],
         "host_cache_bytes": on_snap["host_cache_bytes"],
+    }
+
+
+def _router_slo_report(model, variables, gen_cfg, slots):
+    """The multi-replica SLO goodput record (module docstring): one
+    seeded two-tenant trace replayed against a ServingRouter over N
+    warmed replicas AT saturation (everything completes — asserted) and
+    PAST it (bounded queue + TTL shed gracefully, survivors complete —
+    asserted). Wall-clock-free determinism lives in the trace hash; the
+    scores are this host's latency truth."""
+    import jax
+
+    from fleetx_tpu.serving import (
+        ServingEngine,
+        ServingRouter,
+        TenantSpec,
+        WorkloadSpec,
+        generate_trace,
+        run_trace,
+        score_goodput,
+        trace_hash,
+    )
+
+    n_replicas = 2 if _TINY else 3
+    n_requests = 8 if _TINY else 24
+    prompt_rng = (3, 8) if _TINY else (32, 128)
+    gen_rng = (3, 6) if _TINY else (16, 64)
+    prefix = 4 if _TINY else PREFIX_LEN
+
+    def tenants(ttft_s, tpot_ms):
+        return (
+            TenantSpec("chat", weight=2.0, prompt_len=prompt_rng,
+                       gen_len=gen_rng, ttft_deadline_s=ttft_s,
+                       tpot_deadline_ms=tpot_ms),
+            TenantSpec("template", weight=1.0, prompt_len=prompt_rng,
+                       gen_len=gen_rng, shared_prefix_len=prefix,
+                       ttft_deadline_s=ttft_s, tpot_deadline_ms=tpot_ms),
+        )
+
+    at_rate = 50.0 if _TINY else 10.0
+    at_spec = WorkloadSpec(
+        seed=17, n_requests=n_requests, arrival_rate=at_rate,
+        vocab=model.cfg.vocab_size, tenants=tenants(60.0, 5000.0),
+        burst_every_s=0.5, burst_len_s=0.1, burst_factor=3.0)
+    # past saturation: the whole burst arrives inside one scheduler
+    # window (rate x200 => sub-ms inter-arrivals) against a router queue
+    # bounded BELOW the burst, so shedding is structural, not a host-
+    # speed coin flip — the record's claim is the degradation SHAPE
+    past_spec = WorkloadSpec(
+        seed=18, n_requests=n_requests, arrival_rate=at_rate * 200,
+        vocab=model.cfg.vocab_size, tenants=tenants(60.0, 5000.0))
+    at_trace, past_trace = generate_trace(at_spec), generate_trace(past_spec)
+
+    replicas = [
+        ServingEngine(model, variables, slots=slots,
+                      cache_len=model.cfg.max_position_embeddings,
+                      gen_cfg=gen_cfg, prefill_bucket=8 if _TINY else 32)
+        for _ in range(n_replicas)
+    ]
+    # warmup pass: replay the at-trace once untimed so prefill-bucket /
+    # decode compiles don't masquerade as TTFT in the scored passes
+    run_trace(ServingRouter(replicas), at_trace)
+
+    at_router = ServingRouter(replicas)
+    at_score = score_goodput(run_trace(at_router, at_trace))
+    assert at_score["requests"] == n_requests, at_score
+    assert at_score["completed_frac"] == 1.0, (
+        f"at-saturation pass lost requests: {at_score}")
+
+    past_router = ServingRouter(
+        replicas, max_queue=max(2, n_replicas),
+        queue_ttl_s=1.0 if _TINY else 5.0)
+    past_score = score_goodput(run_trace(past_router, past_trace))
+    assert past_score["requests"] == n_requests, past_score
+    assert past_score["shed_frac"] > 0, (
+        f"past-saturation pass never shed (not saturated?): {past_score}")
+    assert past_score["completed_frac"] > 0, (
+        f"past-saturation pass collapsed (nothing completed): {past_score}")
+    assert set(past_score["finish_reasons"]) <= {
+        "eos", "max_length", "timeout", "rejected", "cache_full"}, (
+        f"uncontrolled degradation past saturation: {past_score}")
+
+    at_snap = at_router.metrics.snapshot()
+    return {
+        "requests": n_requests,
+        "n_replicas": n_replicas,
+        "replica_slots": slots,
+        "workload_hash_at": trace_hash(at_trace),
+        "workload_hash_past": trace_hash(past_trace),
+        "at": at_score,
+        "past": past_score,
+        "at_arrival_rate": at_rate,
+        "past_arrival_rate": past_spec.arrival_rate,
+        "dispatched": at_snap["dispatched"],
+        "affinity_hits": at_snap["affinity_hits"],
+        "replica_deaths": at_snap["replica_deaths"],
+        "device": getattr(jax.devices()[0], "device_kind", "?"),
     }
 
 
@@ -777,6 +888,19 @@ def serving_records(n_requests: int = N_REQUESTS, slots: int = SLOTS):
             "vs_baseline": None,  # reference serves static batches only
             "detail": detail,
         })
+
+    # multi-replica SLO goodput record (docs/SERVING.md "Multi-replica
+    # router"): its headline is a FRACTION, not tokens/s — the router's
+    # regression gate is "the fleet still meets its SLOs at saturation
+    # and degrades gracefully past it"
+    router_detail = _router_slo_report(model, variables, gen_cfg, slots)
+    records.append({
+        "metric": "gpt_345m_serving_router_slo",
+        "value": router_detail["at"]["goodput"],
+        "unit": "goodput_frac",
+        "vs_baseline": None,
+        "detail": router_detail,
+    })
     return records
 
 

@@ -45,7 +45,20 @@ per scenario, non-zero exit on any failure:
   replayed request revives the same spilled pages again (inclusive
   store) and finishes byte-identical to one-shot ``generate()``
   (page_spill / page_revive / tick_fault / engine_recovery events
-  asserted).
+  asserted);
+- ``router_kill``: a replica of a 3-replica ``ServingRouter`` is KILLED
+  mid-burst (``FLEETX_FAULT_REPLICA_KILL``): every request still reaches
+  exactly one terminal result, migrated requests resume on survivors
+  BYTE-IDENTICAL to a clean single replica (zero token loss through the
+  admit-with-history replay seam), the seeded-workload goodput score
+  shows a latency blip but no lost requests, and ``replica_dead`` +
+  ``request_migrated`` events are banked;
+- ``router_saturation``: a router pushed PAST saturation (bounded queue
+  + tight deadlines) degrades gracefully — over-bound submits reject
+  with ``QueueFull``, expired queued requests shed as
+  ``finish_reason="timeout"``, every accepted request still reaches
+  exactly one terminal result, and the router keeps serving afterwards
+  (never collapses).
 
 Usage::
 
@@ -686,6 +699,127 @@ def scenario_serving_spill(tmp):
             "revived pages, byte parity held, events banked")
 
 
+def scenario_router_kill(tmp):
+    """A replica killed mid-burst: zero-token-loss migration, exactly
+    one terminal result per request, byte parity vs a clean single
+    replica, goodput shows a blip but no lost requests."""
+    import numpy as np
+
+    from fleetx_tpu.obs import get_event_log
+    from fleetx_tpu.resilience.faults import faults
+    from fleetx_tpu.serving import (
+        ServingRouter,
+        TenantSpec,
+        WorkloadSpec,
+        generate_trace,
+        run_trace,
+        score_goodput,
+        trace_hash,
+    )
+
+    make, prompts = _serving_fixture()
+    # clean single-replica reference streams (batch composition never
+    # changes greedy tokens, so one engine is THE reference)
+    clean, _, _ = _run_workload(make(True), prompts)
+    streams = {}
+
+    def cb(rid, tok, fin):
+        streams.setdefault(rid, []).append(int(tok))
+
+    faults.configure(replica_kill="1:3")
+    try:
+        router = ServingRouter([make(True) for _ in range(3)],
+                               probe_every=1)
+        rids = [router.submit(p, max_length=8, on_token=cb)
+                for p in prompts]
+        res = router.drain(max_ticks=500)
+    finally:
+        faults.reset()
+    assert len(res) == len(prompts), (
+        f"{len(prompts)} submitted, {len(res)} terminal results — "
+        "requests were lost or duplicated")
+    for i, rid in enumerate(rids):
+        assert np.array_equal(np.asarray(res[rid].tokens), clean[i]), (
+            f"request {rid} diverged from the clean single replica "
+            "after the kill")
+        assert streams[rid] == list(clean[i]), (
+            f"request {rid} callback stream has lost/duplicated tokens")
+    ev = get_event_log()
+    dead = ev.find("replica_dead", replica=1)
+    assert dead, "replica death left no replica_dead event"
+    migrated = ev.find("request_migrated")
+    assert migrated, "failover left no request_migrated event"
+    assert ev.find("fault_injected", fault="replica_kill"), \
+        "kill injection left no fault_injected event"
+    m = router.metrics.snapshot()
+    assert m["replica_deaths"] == 1 and m["migrated"] >= 1, m
+    # the goodput view of the same story: a seeded trace over a freshly
+    # killed router — the kill is a latency blip, never a lost request
+    spec = WorkloadSpec(seed=11, n_requests=8, arrival_rate=200.0,
+                        vocab=61,
+                        tenants=(TenantSpec("burst", prompt_len=(3, 6),
+                                            gen_len=(4, 8)),))
+    trace = generate_trace(spec)
+    faults.configure(replica_kill="0:4")
+    try:
+        router2 = ServingRouter([make(True) for _ in range(3)],
+                                probe_every=1)
+        score = score_goodput(run_trace(router2, trace))
+    finally:
+        faults.reset()
+    assert score["completed_frac"] == 1.0, (
+        f"kill lost requests under the seeded workload: {score}")
+    return (f"kill at tick 3 migrated {m['migrated']} request(s) "
+            f"byte-identically ({len(prompts)}/{len(prompts)} exactly-one-"
+            f"result); workload {trace_hash(trace)} goodput "
+            f"{score['goodput']} with ttft_p99 {score['ttft_ms_p99']:.0f}ms"
+            " blip, zero lost")
+
+
+def scenario_router_saturation(tmp):
+    """Past-saturation load: bounded-queue rejects + deadline sheds,
+    every accepted request exactly one terminal result, router alive."""
+    from fleetx_tpu.obs import get_event_log
+    from fleetx_tpu.resilience.faults import faults  # noqa: F401 (reset)
+    from fleetx_tpu.serving import QueueFull, ServingRouter
+
+    make, prompts = _serving_fixture()
+    router = ServingRouter([make(True)], max_queue=6)
+    accepted, rejected = [], 0
+    # a burst far past one 3-slot replica: the bounded queue must reject
+    # the overflow, and the tight-deadline stragglers must shed as
+    # timeouts instead of waiting forever
+    for i in range(12):
+        kw = {"deadline_s": 1e-6} if i in (4, 5) else {}
+        try:
+            accepted.append(router.submit(prompts[i % len(prompts)],
+                                          max_length=8, **kw))
+        except QueueFull:
+            rejected += 1
+    res = router.drain(max_ticks=500)
+    assert rejected > 0, "queue bound never rejected under a 12-burst"
+    assert len(res) == len(accepted), (
+        f"{len(accepted)} accepted, {len(res)} terminal results")
+    reasons = {r: res[r].finish_reason for r in res}
+    assert any(v == "timeout" for v in reasons.values()), (
+        f"tight deadlines never shed: {reasons}")
+    assert all(v in ("eos", "max_length", "timeout")
+               for v in reasons.values()), reasons
+    ev = get_event_log()
+    assert ev.find("queue_reject"), "rejects left no queue_reject event"
+    assert ev.find("request_timeout"), "sheds left no request_timeout event"
+    # never collapses: the router serves normally after the storm
+    rid = router.submit(prompts[0], max_length=8)
+    after = router.drain(max_ticks=200)
+    assert after[rid].finish_reason in ("eos", "max_length")
+    m = router.metrics.snapshot()
+    return (f"12-burst on a 3-slot replica: {rejected} rejected, "
+            f"{sum(v == 'timeout' for v in reasons.values())} shed, "
+            f"{sum(v != 'timeout' for v in reasons.values())} completed, "
+            f"exactly-one-result held ({m['finished']} finished), router "
+            "alive after the storm")
+
+
 SCENARIOS = {
     "sentry": scenario_sentry,
     "sentry_zero": scenario_sentry_zero,
@@ -698,6 +832,8 @@ SCENARIOS = {
     "serving_spec": scenario_serving_spec,
     "serving_mesh": scenario_serving_mesh,
     "serving_spill": scenario_serving_spill,
+    "router_kill": scenario_router_kill,
+    "router_saturation": scenario_router_saturation,
 }
 
 
