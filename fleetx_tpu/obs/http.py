@@ -11,7 +11,9 @@ the endpoint contract docs/OBSERVABILITY.md pins down:
 - ``GET /healthz``  — 200 while every registered health probe passes,
   503 otherwise, with a small JSON body carrying the rotate-out REASON,
   not just the code: ``state`` (``ok`` / ``draining`` / ``dead``, the
-  worst across probes), ``queue_depth`` and ``active`` (summed over
+  worst across probes), ``role`` (the serving phase a disaggregated
+  router keys placement on), ``queue_depth``, ``queue_tokens`` and
+  ``active`` (summed over
   probes that report them), plus per-probe booleans, the failing names,
   and each probe's full report under ``detail``. Probes may return a
   plain bool (healthy yes/no) or a dict with a ``state`` key — the
@@ -129,6 +131,11 @@ def healthz_payload() -> Tuple[bool, Dict]:
     states = [d.get("state", "ok") for d in details.values()]
     state = ("dead" if "dead" in states
              else "draining" if "draining" in states else "ok")
+    # phase role (docs/SERVING.md "Disaggregated prefill/decode"): a
+    # single-engine replica's probe carries it; a phase-aware router
+    # scraping this body keys prefill placement on it + queue_tokens
+    roles = {d["role"] for d in details.values() if "role" in d}
+    role = roles.pop() if len(roles) == 1 else "both"
 
     def total(key):
         # probe reports are caller-supplied: a malformed load field must
@@ -145,7 +152,9 @@ def healthz_payload() -> Tuple[bool, Dict]:
     body = {
         "status": "ok" if ok else "unhealthy",
         "state": state,
+        "role": role,
         "queue_depth": total("queue_depth"),
+        "queue_tokens": total("queue_tokens"),
         "active": total("active"),
         "probes": results,
         "failing": sorted(n for n, v in results.items() if not v),

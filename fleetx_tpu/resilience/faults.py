@@ -43,6 +43,16 @@ selector faults exactly once):
   bisection quarantine isolates). Decode-only by design: a poison that
   dies in its own prefill is already isolated (the engine knows who it
   was admitting) and is covered by ``FLEETX_FAULT_PREFILL_RAISE``.
+- ``FLEETX_FAULT_KV_SHIP_RAISE``: the matching KV export attempts
+  (``ServingEngine.export_kv`` on a prefill-role replica, counted per
+  attempted export) raise ``KVShipFault`` before any page is read — the
+  prefill replica dying mid-handoff; the router falls back to replaying
+  the request on a surviving replica.
+- ``FLEETX_FAULT_KV_SHIP_CORRUPT``: flip one byte inside the matching
+  exported page payloads AFTER serialization — the in-flight bit flip
+  the wire format's crc32 trailer exists to catch; the decode replica's
+  ``payload_from_bytes`` must reject the ship loudly and the router
+  falls back to replay.
 
 Replica-level injection points (the multi-replica router failure
 domain, docs/RESILIENCE.md "Router failover"; the router calls both
@@ -83,6 +93,7 @@ __all__ = [
     "DataFault",
     "FaultInjector",
     "FaultPlan",
+    "KVShipFault",
     "PoisonFault",
     "PrefillFault",
     "ReplicaKilled",
@@ -119,6 +130,12 @@ class ReplicaKilled(RuntimeError):
     device behind a router replica vanished — every further call into its
     engine would hang or fail, so the router must rotate it out and
     migrate its in-flight requests."""
+
+
+class KVShipFault(RuntimeError):
+    """Injected KV-export failure (FLEETX_FAULT_KV_SHIP_RAISE): the
+    prefill-role replica died (or its transport did) mid-handoff — the
+    router must fall back to replaying the request on a survivor."""
 
 
 class _Selector:
@@ -180,6 +197,8 @@ class FaultPlan:
     poison_request: Optional[str] = None
     replica_kill: Optional[str] = None
     probe_flap: Optional[str] = None
+    kv_ship_raise: Optional[str] = None
+    kv_ship_corrupt: Optional[str] = None
 
     @classmethod
     def from_env(cls, env=os.environ) -> Optional["FaultPlan"]:
@@ -208,12 +227,15 @@ class FaultPlan:
             poison_request=env.get("FLEETX_FAULT_POISON_REQUEST") or None,
             replica_kill=env.get("FLEETX_FAULT_REPLICA_KILL") or None,
             probe_flap=env.get("FLEETX_FAULT_PROBE_FLAP") or None,
+            kv_ship_raise=env.get("FLEETX_FAULT_KV_SHIP_RAISE") or None,
+            kv_ship_corrupt=env.get("FLEETX_FAULT_KV_SHIP_CORRUPT") or None,
         )
         if not (plan.nan_batch or plan.data_raise_batch
                 or plan.data_slow_batch or plan.ckpt_save_step
                 or plan.tick_raise or plan.prefill_raise or plan.tick_hang
                 or plan.poison_request or plan.replica_kill
-                or plan.probe_flap):
+                or plan.probe_flap or plan.kv_ship_raise
+                or plan.kv_ship_corrupt):
             return None
         return plan
 
@@ -223,13 +245,15 @@ class FaultInjector:
 
     _ZERO = {"nan": 0, "data_raise": 0, "data_slow": 0, "ckpt": 0,
              "tick_raise": 0, "prefill_raise": 0, "tick_hang": 0,
-             "poison": 0, "replica_kill": 0, "probe_flap": 0}
+             "poison": 0, "replica_kill": 0, "probe_flap": 0,
+             "kv_ship_raise": 0, "kv_ship_corrupt": 0}
 
     def __init__(self):
         self._plan: Optional[FaultPlan] = None
         self._nan_sel = self._raise_sel = self._slow_sel = self._ckpt_sel = None
         self._tick_sel = self._prefill_sel = self._hang_sel = None
         self._poison_sel = None
+        self._ship_raise_sel = self._ship_corrupt_sel = None
         self._kill_pending = set()   # {(replica, router_tick)} unfired
         self._flap_remaining = {}    # replica -> lying probes left
         self._batch_counter = 0
@@ -242,7 +266,7 @@ class FaultInjector:
             plan = FaultPlan(**{k: str(v) if v is not None
                                 and k.endswith(("batch", "step", "raise",
                                                 "hang", "request", "kill",
-                                                "flap")) else v
+                                                "flap", "corrupt")) else v
                                 for k, v in kw.items()})
         def sel(field):
             spec = getattr(plan, field, None) if plan else None
@@ -264,6 +288,8 @@ class FaultInjector:
         self._prefill_sel = sel("prefill_raise")
         self._hang_sel = sel("tick_hang")
         self._poison_sel = sel("poison_request")
+        self._ship_raise_sel = sel("kv_ship_raise")
+        self._ship_corrupt_sel = sel("kv_ship_corrupt")
         kill = getattr(plan, "replica_kill", None) if plan else None
         flap = getattr(plan, "probe_flap", None) if plan else None
         self._kill_pending = set(
@@ -382,6 +408,29 @@ class FaultInjector:
                 f"injected poison-request failure (requests {hits} in the "
                 "decode batch, FLEETX_FAULT_POISON_REQUEST)")
 
+
+    def on_kv_ship(self, attempt: int, request_id: int) -> None:
+        """Raise :class:`KVShipFault` when KV-export attempt ``attempt``
+        matches (attempts count every ``export_kv`` call on the replica,
+        so the index is deterministic across retries)."""
+        if self._ship_raise_sel and attempt in self._ship_raise_sel:
+            self.injected["kv_ship_raise"] += 1
+            obs_emit("fault_injected", fault="kv_ship_raise",
+                     attempt=attempt, request=request_id)
+            raise KVShipFault(
+                f"injected KV-export failure at ship attempt {attempt} "
+                f"(request {request_id}, FLEETX_FAULT_KV_SHIP_RAISE)")
+
+    def on_kv_ship_corrupt(self, attempt: int) -> bool:
+        """True when export attempt ``attempt`` should corrupt its
+        serialized payload (the engine flips one byte past the header so
+        the crc32 check on the receiving side fails loudly)."""
+        if self._ship_corrupt_sel and attempt in self._ship_corrupt_sel:
+            self.injected["kv_ship_corrupt"] += 1
+            obs_emit("fault_injected", fault="kv_ship_corrupt",
+                     attempt=attempt)
+            return True
+        return False
 
     def on_router_tick(self, replica: int, tick: int) -> None:
         """Raise :class:`ReplicaKilled` when the router is about to tick

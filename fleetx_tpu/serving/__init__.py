@@ -27,10 +27,12 @@ SLO goodput scorer). docs/SERVING.md has the architecture tour.
 """
 
 from fleetx_tpu.serving.cache_manager import (
+    DiskPageStore,
     HostPageStore,
     PagedKVCacheManager,
     PagePool,
     SlotKVCacheManager,
+    TieredPageStore,
     scatter_slot,
 )
 from fleetx_tpu.serving.engine import (
@@ -72,10 +74,12 @@ __all__ = [
     "ServingResult",
     "ShuttingDown",
     "TickTimeout",
+    "DiskPageStore",
     "HostPageStore",
     "PagePool",
     "PagedKVCacheManager",
     "SlotKVCacheManager",
+    "TieredPageStore",
     "FIFOScheduler",
     "Request",
     "DraftModelProposer",

@@ -70,16 +70,20 @@ drive the pool with dummy payloads, no backend needed).
 
 from __future__ import annotations
 
+import hashlib
 import heapq
 import math
+import os
 import struct
+import zlib
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
-__all__ = ["HostPageStore", "PagePool", "PagedKVCacheManager",
-           "SlotKVCacheManager", "leaf_device_nbytes", "scatter_slot"]
+__all__ = ["DiskPageStore", "HostPageStore", "PagePool",
+           "PagedKVCacheManager", "SlotKVCacheManager", "TieredPageStore",
+           "leaf_device_nbytes", "scatter_slot"]
 
 
 def leaf_device_nbytes(leaf) -> int:
@@ -180,15 +184,19 @@ class HostPageStore:
     # (K page, V page, int8 scale pages when quantized) with None holding
     # the slots of rank-<4 leaves (the cache_index scalars that never
     # spill). to_bytes/from_bytes give that payload a PICKLE-FREE,
-    # byte-exact wire form — the page-ship primitive a cross-replica
-    # prefill/decode split serializes over the network (ROADMAP item 2),
-    # with none of pickle's arbitrary-code-execution surface on the
-    # receiving replica. Layout (little-endian): magic "FXPG" + u16
-    # version + u16 entry count, then per entry a none/array flag and,
-    # for arrays, dtype string + shape + raw C-order bytes.
+    # byte-exact wire form — the page-ship primitive the disaggregated
+    # prefill/decode split and the shared DiskPageStore serialize over
+    # (docs/SERVING.md "Disaggregated prefill/decode"), with none of
+    # pickle's arbitrary-code-execution surface on the receiving replica.
+    # Layout (little-endian): magic "FXPG" + u16 version + u16 entry
+    # count, then per entry a none/array flag and, for arrays, dtype
+    # string + shape + raw C-order bytes; a crc32 of everything before it
+    # trails the whole blob (v2 — a page shipped across processes or read
+    # back off disk must fail loudly on any bit flip, never revive
+    # garbage K/V into a live cache).
 
     _MAGIC = b"FXPG"
-    _VERSION = 1
+    _VERSION = 2  # v2 = v1 + crc32 trailer; v1 blobs are rejected
 
     @staticmethod
     def payload_to_bytes(payload) -> bytes:
@@ -220,21 +228,40 @@ class HostPageStore:
             raw = a.tobytes()
             out.append(struct.pack("<Q", len(raw)))
             out.append(raw)
-        return b"".join(out)
+        body = b"".join(out)
+        return body + struct.pack("<I", zlib.crc32(body))
 
     @staticmethod
     def payload_from_bytes(buf: bytes) -> list:
-        """Inverse of :meth:`payload_to_bytes` (malformed/truncated input
-        raises ValueError — a corrupt shipped page must fail loudly, not
-        revive garbage K/V)."""
+        """Inverse of :meth:`payload_to_bytes` (malformed/truncated/
+        corrupted input raises ValueError — a corrupt shipped page must
+        fail loudly, not revive garbage K/V). The crc32 trailer is
+        verified BEFORE any entry is parsed, and pre-crc v1 blobs are
+        rejected by version with an explicit error."""
         view = memoryview(buf)
         if bytes(view[:4]) != HostPageStore._MAGIC:
             raise ValueError("not a HostPageStore payload (bad magic)")
+        if len(buf) < 12:  # magic + header + crc32 trailer
+            raise ValueError(
+                f"truncated payload: {len(buf)} bytes is shorter than the "
+                "8-byte header + 4-byte crc32 trailer")
+        version, count = struct.unpack("<HH", view[4:8])
+        if version != HostPageStore._VERSION:
+            raise ValueError(
+                f"unsupported payload version {version}: this build "
+                f"writes/reads v{HostPageStore._VERSION} (crc32-trailed); "
+                "v1 predates the checksum — re-spill the page with a "
+                "current build")
+        (want_crc,) = struct.unpack("<I", view[-4:])
+        got_crc = zlib.crc32(view[:-4])
+        if got_crc != want_crc:
+            raise ValueError(
+                f"payload crc32 mismatch (stored {want_crc:#010x}, "
+                f"computed {got_crc:#010x}): the page was corrupted in "
+                "flight or at rest")
+        end = len(buf) - 4
         pos, out = 8, []
         try:
-            version, count = struct.unpack("<HH", view[4:8])
-            if version != HostPageStore._VERSION:
-                raise ValueError(f"unsupported payload version {version}")
             for _ in range(count):
                 flag = view[pos]
                 pos += 1
@@ -260,12 +287,253 @@ class HostPageStore:
             # IndexError: memoryview read past a truncation point;
             # TypeError: np.dtype() on a truncated dtype name — both are
             # the same "corrupt payload" condition the contract promises
-            # to surface as ValueError
+            # to surface as ValueError (the crc check above catches
+            # virtually all of these first; this is defense in depth
+            # against a collision)
             raise ValueError(f"truncated/corrupt payload: {e}") from None
-        if pos != len(buf):
+        if pos != end:
             raise ValueError(
-                f"payload has {len(buf) - pos} trailing bytes")
+                f"payload has {end - pos} trailing bytes before the crc")
         return out
+
+
+class DiskPageStore:
+    """Content-addressed, byte-bounded KV page store on shared disk —
+    the cluster tier of the page cache (``FLEETX_SERVING_DISK_CACHE_DIR``
+    / ``_BYTES``; docs/SERVING.md "Disaggregated prefill/decode").
+
+    Same ``put``/``get``/``pop``/``in`` surface as :class:`HostPageStore`
+    so :class:`PagePool` drives either (or both, via
+    :class:`TieredPageStore`) without caring, but entries live as files
+    under one directory EVERY replica in the fleet points at: a hot
+    system prompt prefilled by any one replica is revivable by all of
+    them, sustaining prefix hit rate past any single replica's host-DRAM
+    budget. Filenames are the sha256 of the page's full token-chunk path
+    (content-addressed — identical tokens produce identical K/V, so a
+    file written by replica A is correct for replica B by construction),
+    contents are the crc32-trailed :meth:`HostPageStore.payload_to_bytes`
+    wire format (a corrupted file fails loudly at decode, never revives
+    garbage). Writes are atomic (tmp + rename) so a reader never sees a
+    half-written page; eviction is LRU by mtime over a directory scan,
+    which stays coherent when several replica processes share the dir
+    (``get`` touches the file). Capacity accounting is by actual file
+    bytes — the serialized page, not the host-array footprint."""
+
+    _SUFFIX = ".fxpg"
+
+    def __init__(self, cache_dir: str, capacity_bytes: int):
+        if capacity_bytes < 0:
+            raise ValueError(
+                f"capacity_bytes must be >= 0, got {capacity_bytes}")
+        if not cache_dir:
+            raise ValueError("cache_dir must be a non-empty path")
+        self.cache_dir = str(cache_dir)
+        self.capacity_bytes = int(capacity_bytes)
+        os.makedirs(self.cache_dir, exist_ok=True)
+        self.spilled_pages = 0  # lifetime puts accepted (this instance)
+        self.revived_pages = 0  # lifetime gets served
+        self.evicted_pages = 0  # lifetime files dropped under the budget
+        self.hits = 0           # gets served (alias kept for the gauge)
+        self.misses = 0         # membership probes that found nothing
+
+    # ----------------------------------------------------------- addressing
+    def _path(self, key) -> str:
+        """File path for a token-chunk-path key: sha256 over the chunks
+        (chunk boundaries separated so ``((1,2),)`` and ``((1,),(2,))``
+        cannot collide), hex digest as the filename."""
+        h = hashlib.sha256()
+        for chunk in key:
+            h.update(np.asarray(chunk, np.int64).tobytes())
+            h.update(b"/")
+        return os.path.join(self.cache_dir, h.hexdigest() + self._SUFFIX)
+
+    def _files(self):
+        """(path, stat) for every store file, oldest-mtime first.
+        Concurrently vanished files (a sibling replica evicted them) are
+        skipped — the scan must tolerate sharing."""
+        out = []
+        try:
+            names = os.listdir(self.cache_dir)
+        except OSError:
+            return []
+        for name in names:
+            if not name.endswith(self._SUFFIX):
+                continue
+            path = os.path.join(self.cache_dir, name)
+            try:
+                out.append((path, os.stat(path)))
+            except OSError:
+                continue
+        out.sort(key=lambda ps: (ps[1].st_mtime, ps[0]))
+        return out
+
+    def __len__(self) -> int:
+        return len(self._files())
+
+    @property
+    def nbytes(self) -> int:
+        """Bytes currently resident (actual file sizes — shared-dir
+        coherent: siblings' writes count too)."""
+        return sum(st.st_size for _, st in self._files())
+
+    def __contains__(self, key) -> bool:
+        if os.path.exists(self._path(key)):
+            return True
+        self.misses += 1
+        return False
+
+    def put(self, key, payload, nbytes: int = 0) -> bool:
+        """Serialize + store one page under its content address,
+        evicting oldest files until the budget holds; False (nothing
+        stored) when the serialized page alone exceeds it. ``nbytes``
+        (the host-array footprint the pool computed) is advisory here —
+        disk accounting uses the wire bytes actually written."""
+        del nbytes  # accounted from the serialized blob below
+        blob = HostPageStore.payload_to_bytes(payload)
+        if len(blob) > self.capacity_bytes:
+            return False
+        path = self._path(key)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)  # atomic: readers see old bytes or new
+        self.spilled_pages += 1
+        total = self.nbytes
+        if total > self.capacity_bytes:
+            for victim, st in self._files():
+                if victim == path:
+                    continue  # never evict the page just written
+                try:
+                    os.remove(victim)
+                except OSError:
+                    continue
+                self.evicted_pages += 1
+                total -= st.st_size
+                if total <= self.capacity_bytes:
+                    break
+        return True
+
+    def get(self, key):
+        """Decode a stored page back to its host-array payload,
+        refreshing its LRU slot (mtime touch — visible to every replica
+        sharing the dir). KeyError when absent; ValueError when the file
+        is corrupt (crc/format — the caller must treat that as a miss
+        that fails loudly, not revive it)."""
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            raise KeyError(key) from None
+        payload = HostPageStore.payload_from_bytes(blob)
+        try:
+            os.utime(path)
+        except OSError:
+            pass  # a sibling evicted it mid-read; the payload is ours
+        self.revived_pages += 1
+        self.hits += 1
+        return payload
+
+    def pop(self, key):
+        """Remove and return an entry's payload (explicit invalidation).
+        KeyError if absent."""
+        payload = self.get(key)
+        self.hits -= 1  # a pop is not a cache hit
+        self.revived_pages -= 1
+        try:
+            os.remove(self._path(key))
+        except OSError:
+            pass
+        return payload
+
+    def check_invariants(self) -> None:
+        """Resident bytes must respect the budget. Tolerates transient
+        overshoot only from files a SIBLING process wrote after this
+        instance's last eviction pass — within one process the budget is
+        re-enforced on every put."""
+        total = self.nbytes
+        assert total <= self.capacity_bytes or len(self._files()) <= 1, (
+            f"disk store over budget: {total} > {self.capacity_bytes}")
+
+
+class TieredPageStore:
+    """Host-DRAM tier over a shared disk tier, behind the one store
+    surface :class:`PagePool` drives (docs/SERVING.md "Disaggregated
+    prefill/decode"): puts write through to both (the local replica keeps
+    DRAM-speed revives, the fleet gets the page), gets serve host-first
+    and fall back to disk — promoting a disk hit back into the host tier
+    so a hot cross-replica prefix pays the file read once. The
+    host-facing counters/properties delegate to the host tier (so
+    ``ServingMetrics.observe_host_tier`` reads a tiered store unchanged);
+    disk counters are scraped off ``.disk`` via ``observe_disk_tier``."""
+
+    def __init__(self, host: HostPageStore, disk: DiskPageStore):
+        self.host = host
+        self.disk = disk
+
+    def __len__(self) -> int:
+        return len(self.host)
+
+    @property
+    def nbytes(self) -> int:
+        return self.host.nbytes
+
+    @property
+    def capacity_bytes(self) -> int:
+        return self.host.capacity_bytes
+
+    @property
+    def spilled_pages(self) -> int:
+        return self.host.spilled_pages
+
+    @property
+    def revived_pages(self) -> int:
+        return self.host.revived_pages
+
+    @property
+    def evicted_pages(self) -> int:
+        return self.host.evicted_pages
+
+    def __contains__(self, key) -> bool:
+        return key in self.host or key in self.disk
+
+    def put(self, key, payload, nbytes: int) -> bool:
+        """Write-through: True when either tier kept the page."""
+        kept_host = self.host.put(key, payload, nbytes)
+        kept_disk = self.disk.put(key, payload, nbytes)
+        return kept_host or kept_disk
+
+    def get(self, key):
+        """Host tier first; a disk hit is promoted into the host tier
+        (counted as a host spill, like any other insertion)."""
+        try:
+            return self.host.get(key)
+        except KeyError:
+            pass
+        payload = self.disk.get(key)
+        nbytes = sum(a.nbytes for a in payload if a is not None)
+        self.host.put(key, payload, nbytes)
+        return payload
+
+    def pop(self, key):
+        """Invalidate in both tiers; payload from whichever had it."""
+        payload = None
+        try:
+            payload = self.host.pop(key)
+        except KeyError:
+            pass
+        try:
+            disk_payload = self.disk.pop(key)
+            payload = payload if payload is not None else disk_payload
+        except KeyError:
+            pass
+        if payload is None:
+            raise KeyError(key)
+        return payload
+
+    def check_invariants(self) -> None:
+        self.host.check_invariants()
+        self.disk.check_invariants()
 
 
 def scatter_slot(cache, prefill_cache, slot):
@@ -940,6 +1208,31 @@ class PagedKVCacheManager(_LaneBook):
             leaves[i] = leaf
         self.cache = jax.tree.unflatten(treedef, leaves)
         obs_emit("page_revive", pages=len(entries))
+
+    # --------------------------------------------- cross-replica page ship
+    # (docs/SERVING.md "Disaggregated prefill/decode"): a prefill-role
+    # replica reads a finished prompt's pages out through the SAME
+    # batched per-leaf device reads the spill tier uses, and a decode-
+    # role replica writes shipped payloads into its own fresh pages
+    # through the SAME batched revive scatter — the ship path adds no new
+    # device code, only the public names.
+
+    def read_pages(self, pages: List[int]) -> List[list]:
+        """Read physical ``pages`` out of the device pool as host
+        payloads (one per page, each a per-cache-leaf list with None for
+        rank-<4 leaves — exactly what :meth:`HostPageStore
+        .payload_to_bytes` serializes). One batched gather + transfer
+        per cache leaf for the whole list, int8 scale pages included."""
+        return [payload for payload, _ in self._spill_pages(pages)]
+
+    def revive_pages(self, entries: List[Tuple[int, list]]) -> None:
+        """Write ``(physical_page, payload)`` entries into the device
+        pool — the decode-role half of a KV handoff, one batched
+        host→device transfer + in-place scatter per cache leaf. The
+        caller owns the bookkeeping: the pages must already be allocated
+        to the receiving lane (``alloc``) and their payloads decoded and
+        validated (``payload_from_bytes`` raises on corruption)."""
+        self._revive_pages(entries)
 
     # ------------------------------------------------------- page surface
 

@@ -61,7 +61,28 @@ prefill entirely — the millions-of-users shared-system-prompt scenario
 where the hot prefix set exceeds HBM. The store is content-addressed and
 engine-owned, so it SURVIVES replay recovery (the rebuilt pool matches
 the same keys) and revived bytes are exactly the spilled bytes: cold vs
-spill-revived decoding is byte-identical.
+spill-revived decoding is byte-identical. A shared-disk tier stacks
+under it (``FLEETX_SERVING_DISK_CACHE_DIR``/``_BYTES``): content-
+addressed wire-format files every replica in the fleet revives from.
+
+Phase-disaggregated serving (``role=`` kwarg / ``FLEETX_SERVING_ROLE``;
+docs/SERVING.md "Disaggregated prefill/decode"): prefill is MXU-bound,
+decode is HBM-bound — colocating them makes each the other's noisy
+neighbor. A ``role="prefill"`` engine runs admission + (chunked)
+prefill to completion, emits the first token, then PARKS the request
+(``prefilled_ready()``) instead of decoding; ``export_kv(request_id)``
+reads the ``ceil(prompt_len/page_size)`` pages covering the prompt out
+of the pool (batched per-leaf gathers, int8 scales included) and
+returns them as crc32-trailed wire-format blobs. A decode replica
+admits them via ``submit(kv_payloads=..., history=[t0])``: pages are
+allocated, shipped payloads written through the revive scatter (no
+re-prefill), the prompt registered in its prefix trie, and decoding
+resumes from ``t0`` with the RNG carry reconstructed — byte-identical
+to colocated decoding. Any handoff failure (export fault, corrupt blob
+caught by the crc at submit, replica death mid-ship) falls back to the
+replay path: ``t0`` is already in the router's durable history, so
+nothing is ever lost, only re-prefilled. ``role="decode"`` is a normal
+engine the router labels for placement.
 
 Per-slot progress is carried as explicit ``cache_positions`` into the
 model (``SelfAttention._update_cache``), so slots decode at different
@@ -221,9 +242,11 @@ from fleetx_tpu.models.gpt.generation import (
     init_decode_cache,
 )
 from fleetx_tpu.serving.cache_manager import (
+    DiskPageStore,
     HostPageStore,
     PagedKVCacheManager,
     SlotKVCacheManager,
+    TieredPageStore,
     scatter_slot,
 )
 from fleetx_tpu.resilience.faults import faults
@@ -376,6 +399,9 @@ class ServingEngine:
                  spec: Optional[bool] = None,
                  spec_k: Optional[int] = None,
                  spec_proposer=None,
+                 role: Optional[str] = None,
+                 disk_cache_dir: Optional[str] = None,
+                 disk_cache_bytes: Optional[int] = None,
                  mesh=None):
         gen_cfg = gen_cfg or GenerationConfig(decode_strategy="greedy")
         if gen_cfg.repetition_penalty != 1.0:
@@ -422,6 +448,22 @@ class ServingEngine:
         self.paged = (paged if paged is not None
                       else _env_int("FLEETX_SERVING_PAGED", 1) == 1)
         self.page_size = page_size or _env_int("FLEETX_SERVING_PAGE_SIZE", 16)
+        # phase-disaggregated serving (docs/SERVING.md "Disaggregated
+        # prefill/decode"): a PREFILL-role replica runs admission and
+        # (chunked) prefill to completion, then PARKS the request for
+        # export_kv() instead of decoding; a DECODE-role replica is a
+        # normal engine whose router feeds it shipped KV. "both" — the
+        # default — is the colocated engine, byte-identical to before.
+        self.role = (role or os.environ.get("FLEETX_SERVING_ROLE", "")
+                     or "both")
+        if self.role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode' or 'both', got "
+                f"{self.role!r}")
+        if self.role == "prefill" and not self.paged:
+            raise ValueError(
+                "role='prefill' requires the paged cache (paged=True): "
+                "export_kv() ships whole pages through the block table")
         cache_len = (cache_len
                      or _env_int("FLEETX_SERVING_CACHE_LEN", 0)
                      or model.cfg.max_position_embeddings)
@@ -500,9 +542,26 @@ class ServingEngine:
         # pinned-host store warm pages spill into instead
         host_bytes = (host_cache_bytes if host_cache_bytes is not None
                       else _env_int("FLEETX_SERVING_HOST_CACHE_BYTES", 0))
-        self._host_store = (HostPageStore(host_bytes)
-                            if host_bytes > 0 and self.paged
-                            and self.prefix_cache else None)
+        # cluster page tier (docs/SERVING.md "Disaggregated prefill/
+        # decode"): a shared-directory, byte-bounded, content-addressed
+        # disk store every replica points at — the prefix set one
+        # replica's DRAM budget would miss stays warm fleet-wide. With
+        # both tiers configured, TieredPageStore write-throughs puts and
+        # promotes disk hits back into DRAM.
+        disk_dir = (disk_cache_dir if disk_cache_dir is not None
+                    else os.environ.get("FLEETX_SERVING_DISK_CACHE_DIR", ""))
+        disk_bytes = (disk_cache_bytes if disk_cache_bytes is not None
+                      else _env_int("FLEETX_SERVING_DISK_CACHE_BYTES", 0))
+        tiered = self.paged and self.prefix_cache
+        dram = HostPageStore(host_bytes) if host_bytes > 0 and tiered else None
+        self._disk_store = (DiskPageStore(disk_dir, disk_bytes)
+                            if disk_dir and disk_bytes > 0 and tiered
+                            else None)
+        self._dram_store = dram
+        self._host_store = (
+            TieredPageStore(dram, self._disk_store)
+            if dram is not None and self._disk_store is not None
+            else dram if dram is not None else self._disk_store)
         self.log_every = (log_every if log_every is not None
                           else _env_int("FLEETX_SERVING_LOG_EVERY", 0))
         # admission control (module docstring): all default OFF — an
@@ -530,6 +589,7 @@ class ServingEngine:
         self._fault_ctx = None              # ("prefill", rid) during prefill
         self._fault_ticks = 0               # attempted decode device calls
         self._fault_prefills = 0            # attempted prefill device calls
+        self._fault_ships = 0               # attempted KV exports
         self._watchdog = None               # lazy single-thread executor
         self.hang_diagnostics = None        # banked by the watchdog
         self._shutting_down = False
@@ -553,6 +613,7 @@ class ServingEngine:
         self._tables_version = -1     # refreshed when the manager's moves
         self.scheduler = FIFOScheduler()
         self.metrics = metrics or ServingMetrics(self.slots)
+        self.metrics.set_role(self.role)
         self._publish_quant_metrics()
         self._base_key = jax.random.PRNGKey(base_seed)
         self._next_id = 0
@@ -561,6 +622,10 @@ class ServingEngine:
         # chunked prefill: slot -> the request mid-prefill there (at most
         # one by policy — the FIFO head — a dict for snapshot symmetry)
         self._prefilling: Dict[int, Request] = {}
+        # disaggregated prefill: slot -> request whose prompt KV is fully
+        # written on this PREFILL-role replica, parked (lane + pages held,
+        # decode lane inert) until the router calls export_kv()
+        self._prefilled: Dict[int, Request] = {}
         self._results: Dict[int, ServingResult] = {}
         self._state = self._replicate(self._init_state())
         # buffer donation halves cache HBM residency on TPU; skipped on
@@ -652,7 +717,7 @@ class ServingEngine:
                seed: Optional[int] = None, rng_key: Optional[jax.Array] = None,
                on_token=None, queue_ttl_s: Optional[float] = None,
                deadline_s: Optional[float] = None,
-               history=None) -> int:
+               history=None, kv_payloads=None) -> int:
         """Queue one request; returns its id. Kwargs override the engine's
         ``gen_cfg`` defaults per request; ``seed`` (or a raw ``rng_key``)
         pins this request's private sampling stream, ``on_token`` streams
@@ -675,7 +740,19 @@ class ServingEngine:
         fires only for NEWLY decoded tokens (the caller already delivered
         the history). A history that is already terminal (ends in EOS, or
         exhausts ``max_length``) is a caller bug and raises ValueError —
-        migrate unfinished requests only."""
+        migrate unfinished requests only.
+
+        ``kv_payloads`` is the DISAGGREGATED-HANDOFF seam (docs/
+        SERVING.md "Disaggregated prefill/decode"): the wire-format page
+        blobs a PREFILL-role replica's :meth:`export_kv` shipped for
+        this prompt, one per page covering the prompt, alongside
+        ``history=[t0, ...]`` (the first token that replica emitted).
+        The blobs are decoded and validated HERE — a corrupted ship
+        raises ValueError at submit, before the request ever queues, so
+        the router can fall back to the replay path — and admission
+        writes them straight into freshly allocated pages through the
+        revive scatter: no prefill forward at all, byte-identical
+        decoding to the colocated engine."""
         if self._shutting_down:
             self.metrics.record_drain_reject()
             obs_emit("drain_reject", engine=self.metrics.engine_label)
@@ -745,6 +822,35 @@ class ServingEngine:
                     f"history ({len(hist)} tokens) meets or exceeds the "
                     f"max_length budget ({max_new}) — the request is "
                     "terminal; do not migrate it")
+        decoded_pages = None
+        if kv_payloads is not None:
+            if not self.paged:
+                raise ValueError(
+                    "kv_payloads requires the paged cache (paged=True): "
+                    "shipped KV revives into pages")
+            if not hist:
+                raise ValueError(
+                    "kv_payloads without history: the prefill replica "
+                    "sampled the first token — pass it as history=[t0]")
+            need = -(-prompt.size // self.page_size)
+            if len(kv_payloads) != need:
+                raise ValueError(
+                    f"kv_payloads has {len(kv_payloads)} page blob(s); a "
+                    f"{prompt.size}-token prompt at page_size "
+                    f"{self.page_size} ships {need}")
+            # decode NOW, not at admission: payload_from_bytes verifies
+            # the crc32 trailer, so a corrupted ship fails this submit
+            # loudly and the request never enters the queue half-armed
+            decoded_pages = [
+                HostPageStore.payload_from_bytes(b)
+                if isinstance(b, (bytes, bytearray, memoryview)) else b
+                for b in kv_payloads]
+            for leaf in decoded_pages[0]:
+                if leaf is not None and leaf.shape[-3] != self.page_size:
+                    raise ValueError(
+                        f"shipped pages carry {leaf.shape[-3]} rows; this "
+                        f"replica's page_size is {self.page_size} — "
+                        "disaggregated replicas must agree on page_size")
         rid = self._next_id
         self._next_id += 1
         if rng_key is None:
@@ -770,6 +876,7 @@ class ServingEngine:
         # before admission must still return them — zero token loss), and
         # _admit routes a non-empty list through the replay prefill seam
         req.tokens.extend(hist)
+        req.kv_payloads = decoded_pages
         self.scheduler.submit(req)
         self.metrics.record_submit()
         return rid
@@ -788,7 +895,7 @@ class ServingEngine:
         if (self._shutting_down and self._shutdown_deadline is not None
                 and t0 >= self._shutdown_deadline
                 and (len(self.scheduler) or self._active
-                     or self._prefilling)):
+                     or self._prefilling or self._prefilled)):
             # grace window over: everything still in flight returns NOW
             # with its partial tokens
             retired = self._retire_all("shutdown")
@@ -827,8 +934,14 @@ class ServingEngine:
         if self.paged:
             self.metrics.observe_pages(self.cache_manager.pages_in_use,
                                        self.cache_manager.usable_pages)
-        if self._host_store is not None:
-            self.metrics.observe_host_tier(self._host_store)
+        if self._dram_store is not None:
+            self.metrics.observe_host_tier(self._dram_store)
+        if self._disk_store is not None:
+            self.metrics.observe_disk_tier(self._disk_store)
+        self.metrics.observe_queue_tokens(
+            self.scheduler.queued_tokens() + sum(
+                r.prompt_len - r.prefill_pos
+                for r in self._prefilling.values()))
         if self.log_every and self._ticks % self.log_every == 0:
             self.metrics.log_snapshot()
         summary.setdefault("recovered", False)
@@ -836,6 +949,7 @@ class ServingEngine:
         summary["queue_depth"] = self.scheduler.queue_depth
         summary["active_slots"] = len(self._active)
         summary["prefilling"] = len(self._prefilling)
+        summary["prefilled"] = len(self._prefilled)
         return summary
 
     def _step_inner(self, commit=lambda: None) -> Dict:
@@ -887,7 +1001,8 @@ class ServingEngine:
         req = self.scheduler.remove(request_id)
         if req is None:
             for r in (list(self._active.values())
-                      + list(self._prefilling.values())):
+                      + list(self._prefilling.values())
+                      + list(self._prefilled.values())):
                 if r.id == request_id:
                     req = r
                     break
@@ -936,11 +1051,13 @@ class ServingEngine:
         :meth:`recover` rebuilds the device side from it. Metrics stay
         monotonic (a rolled-back tick's gauge samples are not unwound)."""
         reqs = (list(self.scheduler.snapshot()) + list(self._active.values())
-                + list(self._prefilling.values()))
+                + list(self._prefilling.values())
+                + list(self._prefilled.values()))
         return {
             "queue": self.scheduler.snapshot(),
             "active": dict(self._active),
             "prefilling": dict(self._prefilling),
+            "prefilled": dict(self._prefilled),
             "results": dict(self._results),
             # per-request mutable fields the tick touches; tokens rolls
             # back by truncating to its pre-tick length (the list object
@@ -961,6 +1078,7 @@ class ServingEngine:
         self.scheduler.restore(snap["queue"])
         self._active = snap["active"]
         self._prefilling = snap["prefilling"]
+        self._prefilled = snap["prefilled"]
         self._results = snap["results"]
         for (r, slot, admit_t, first_t, ntok, ppos, phase, sprop,
              sacc) in snap["reqs"]:
@@ -1046,6 +1164,11 @@ class ServingEngine:
                   recovery=self.metrics.engine_recoveries):
             old_active = sorted(self._active.items())
             self._active = {}
+            # parked (prefilled, awaiting export) requests replay like
+            # active ones — their KV died with the device cache — then
+            # re-park with the lane deactivated, still export-ready
+            old_parked = sorted(self._prefilled.items())
+            self._prefilled = {}
             # mid-prefill (chunked) requests: their partial KV died with
             # the device cache and ZERO tokens were emitted, so they go
             # back to the queue HEAD (they were the head when admitted)
@@ -1102,6 +1225,29 @@ class ServingEngine:
                     retired.append(req.id)
                     continue
                 self._active[req.slot] = req
+            for _, req in old_parked:
+                req.slot = None
+                try:
+                    self._replay(req)
+                except Exception:  # noqa: BLE001 — isolate, don't cascade
+                    logger.exception(
+                        "serving: parked request %d failed its replay "
+                        "during recovery; quarantining it "
+                        "(finish_reason='error')", req.id)
+                    if req.slot is not None:
+                        self.cache_manager.free(req.slot)
+                        req.slot = None
+                    self._finalize(req, "error", self._now())
+                    self.metrics.record_poison()
+                    obs_emit("poison_retired", request=req.id, via="replay")
+                    retired.append(req.id)
+                    continue
+                # _replay installs an ACTIVE lane; a parked request must
+                # stay off the decode tick until export_kv() ships it
+                self._state = self._deactivate_jit(
+                    self._state, jnp.asarray(req.slot, jnp.int32))
+                req.phase = "prefilled"
+                self._prefilled[req.slot] = req
         obs_emit("engine_recovery", number=self.metrics.engine_recoveries,
                  replayed=len(self._active), quarantined=len(retired))
         logger.warning(
@@ -1299,7 +1445,8 @@ class ServingEngine:
         # an idle engine drains without a single tick, so flush the
         # deferred shutdown event here too (step() flushes it otherwise)
         self._flush_shutdown_event()
-        while len(self.scheduler) or self._active or self._prefilling:
+        while (len(self.scheduler) or self._active or self._prefilling
+               or self._prefilled):
             self.step()  # the deadline check inside step() retires leftovers
         out, self._results = self._results, {}
         return out
@@ -1324,7 +1471,8 @@ class ServingEngine:
             self._finalize(req, reason, now)
             retired.append(req.id)
         for req in (list(self._active.values())
-                    + list(self._prefilling.values())):
+                    + list(self._prefilling.values())
+                    + list(self._prefilled.values())):
             self._evict(req, reason, now)
             retired.append(req.id)
         return retired
@@ -1444,10 +1592,63 @@ class ServingEngine:
         process analogue of a streaming client re-syncing its offset."""
         for r in (list(self._active.values())
                   + list(self._prefilling.values())
+                  + list(self._prefilled.values())
                   + list(self.scheduler.snapshot())):
             if r.id == request_id:
                 return list(r.tokens)
         return None
+
+    # ------------------------------------------- disaggregated prefill
+
+    def prefilled_ready(self) -> list:
+        """Request ids parked on this PREFILL-role replica with their
+        prompt KV fully written, awaiting :meth:`export_kv`
+        (docs/SERVING.md "Disaggregated prefill/decode")."""
+        return sorted(r.id for r in self._prefilled.values())
+
+    def export_kv(self, request_id: int) -> list:
+        """Ship one parked request's prompt KV: walk its block table for
+        the ``ceil(prompt_len / page_size)`` pages covering the prompt,
+        read them through the same batched per-leaf device gathers the
+        host spill tier uses (int8 scale leaves included), and serialize
+        each page in the crc32-trailed wire format. On success the
+        request finalizes ``finish_reason="prefilled"`` — its lane and
+        pages free (the prompt stays warm in THIS replica's prefix trie)
+        — and the blobs return in prompt order, ready for
+        ``submit(kv_payloads=..., history=[t0])`` on a decode replica.
+        Raises KeyError for an id that is not parked; any export fault
+        propagates WITHOUT losing the request (it stays parked, its
+        emitted first token stays in the router's durable history), so
+        the caller falls back to the replay path."""
+        req = next((r for r in self._prefilled.values()
+                    if r.id == request_id), None)
+        if req is None:
+            raise KeyError(
+                f"request {request_id} is not parked for export "
+                f"(parked: {self.prefilled_ready()})")
+        attempt = self._fault_ships
+        self._fault_ships += 1
+        faults.on_kv_ship(attempt, request_id)
+        n_pages = -(-req.prompt_len // self.page_size)
+        table = self.cache_manager.tables[req.slot]
+        pages = [int(table[i]) for i in range(n_pages)]
+        with span("serving.export_kv", request=request_id, pages=n_pages):
+            payloads = self.cache_manager.read_pages(pages)
+        blobs = [HostPageStore.payload_to_bytes(p) for p in payloads]
+        if faults.on_kv_ship_corrupt(attempt):
+            # chaos seam: flip one byte mid-blob (past the header) — the
+            # crc32 trailer must catch it on the decode side's submit
+            mid = len(blobs) // 2
+            flipped = bytearray(blobs[mid])
+            flipped[len(flipped) // 2] ^= 0xFF
+            blobs[mid] = bytes(flipped)
+        nbytes = sum(len(b) for b in blobs)
+        self.metrics.record_kv_shipped(len(blobs), nbytes)
+        del self._prefilled[req.slot]
+        self._finalize(req, "prefilled", self._now())
+        obs_emit("kv_shipped", request=request_id, pages=len(blobs),
+                 bytes=nbytes)
+        return blobs
 
     def health(self) -> Dict:
         """The drain-aware health report (the ``/healthz`` JSON body,
@@ -1460,10 +1661,22 @@ class ServingEngine:
         contract the multi-replica router and any external LB consume."""
         state = ("dead" if self._dead
                  else "draining" if self._shutting_down else "ok")
-        return {"state": state,
-                "queue_depth": self.scheduler.queue_depth,
-                "active": len(self._active) + len(self._prefilling),
-                "slots": self.slots}
+        out = {"state": state,
+               "role": self.role,
+               "queue_depth": self.scheduler.queue_depth,
+               # prefill load prices in TOKENS (prefill cost scales with
+               # prompt length, not request count): queued prompts plus
+               # the unwritten remainder of any in-flight chunked prefill
+               "queue_tokens": self.scheduler.queued_tokens() + sum(
+                   r.prompt_len - r.prefill_pos
+                   for r in self._prefilling.values()),
+               "active": (len(self._active) + len(self._prefilling)
+                          + len(self._prefilled)),
+               "slots": self.slots}
+        if self.paged:
+            out["pages_in_use"] = self.cache_manager.pages_in_use
+            out["usable_pages"] = self.cache_manager.usable_pages
+        return out
 
     def declare_dead(self) -> None:
         """Mark the engine dead (``health()``/``/healthz`` report
@@ -1949,7 +2162,12 @@ class ServingEngine:
         replay seam instead: one whole-history prefill + lane install
         with the RNG position reconstructed, no callbacks re-fired —
         byte-for-byte the recovery replay of PR 8, aimed at a request
-        another replica started."""
+        another replica started. A request carrying SHIPPED page
+        payloads skips even that prefill: :meth:`_admit_shipped` writes
+        them straight into its fresh pages."""
+        if req.kv_payloads is not None:
+            self._admit_shipped(req)
+            return
         if req.tokens:
             self._fault_ctx = ("prefill", req.id)
             with span("serving.admit", request=req.id,
@@ -1993,6 +2211,66 @@ class ServingEngine:
         req.admit_time = now
         self.metrics.record_admit(now - req.submit_time)
         self._finish_first_token(req, int(tok), carry_key)
+
+    def _admit_shipped(self, req: Request) -> None:
+        """Admit a request whose prompt KV arrived from a PREFILL-role
+        replica (``submit(kv_payloads=...)``): claim a page chain, write
+        the shipped pages through the same batched revive scatter the
+        host spill tier uses — zero prefill forwards — register the
+        prompt in the prefix trie, and install the decode lane resuming
+        from ``history[-1]`` with the RNG carry advanced exactly as the
+        prefill replica left it. Byte-identical to colocated decoding by
+        construction: the pages hold the very K/V bytes that replica's
+        prefill wrote. The payloads are consumed UP FRONT, so if this
+        admission faults and the transactional tick rolls it back, the
+        requeued request re-admits through the replay seam (a re-prefill
+        — slower, never wrong)."""
+        payloads, req.kv_payloads = req.kv_payloads, None
+        self._fault_ctx = ("prefill", req.id)
+        with span("serving.admit_shipped", request=req.id,
+                  prompt_len=req.prompt_len, pages=len(payloads)):
+            alloc = self.cache_manager.alloc(req.id, req.prompt)
+            if alloc is None:  # _can_admit() passed, so this is an
+                raise RuntimeError(  # invariant breach — fail loudly
+                    f"paged alloc failed after admission check for shipped "
+                    f"request {req.id} (prompt {req.prompt_len} tokens; "
+                    f"{self.cache_manager.pool.free_pages} pages free)")
+            lane, shared = alloc
+            req.slot = lane
+            # trie/host-revived prefix pages are already populated —
+            # revive only the shipped pages beyond them
+            start = shared // self.page_size
+            table = self.cache_manager.tables[lane]
+            entries = [(int(table[i]), payloads[i])
+                       for i in range(start, len(payloads))]
+            if entries:
+                self.cache_manager.revive_pages(entries)
+            self.cache_manager.register_prefix(lane, req.prompt)
+        self._fault_ctx = None
+        self._prefill_strikes.pop(req.id, None)
+        pool = self.cache_manager.pool
+        self.metrics.record_prefix(
+            shared, req.prompt_len,
+            int(pool.alloc_counts[lane] - pool.shared_counts[lane]))
+        self.metrics.record_kv_revived_remote(len(entries))
+        now = self._now()
+        req.admit_time = now
+        self.metrics.record_admit(now - req.submit_time)
+        # RNG carry: the prefill replica consumed ONE split sampling t0,
+        # plus one per later non-greedy history token — identical to the
+        # replay reconstruction (greedy lanes never read the stream)
+        n = len(req.tokens)
+        carry = req.rng_key
+        if not req.greedy:
+            for _ in range(n):
+                carry = jax.random.split(carry)[1]
+        self._install_lane(
+            req, tok=int(req.tokens[-1]), length=req.prompt_len + n - 1,
+            decoded=n, active=True, carry_key=carry)
+        req.phase = "active"
+        self._active[req.slot] = req
+        obs_emit("kv_revived_remote", request=req.id, pages=len(entries),
+                 shared=shared)
 
     def _run_chunk(self, req: Request) -> None:
         """One prefill chunk for a mid-prefill request. Intermediate
@@ -2063,8 +2341,13 @@ class ServingEngine:
         self.metrics.record_tokens(1)
         done_eos = req.eos_token_id >= 0 and tok == req.eos_token_id
         done = done_eos or req.max_new_tokens <= 1
+        # a PREFILL-role replica never decodes: an unfinished request
+        # parks for export_kv() with its lane INERT (active=False keeps
+        # any stray decode tick off its pages)
+        parked = self.role == "prefill" and not done
         self._install_lane(req, tok=tok, length=req.prompt_len, decoded=1,
-                           active=not done, carry_key=carry_key)
+                           active=not done and not parked,
+                           carry_key=carry_key)
         # callback AFTER the device state is consistent: a raising callback
         # then retires exactly this request and can't leave the slot half-
         # installed (previously it unwound _admit between cache scatter and
@@ -2073,6 +2356,11 @@ class ServingEngine:
             self._retire_error(req, now)
         elif done:
             self._finalize(req, "eos" if done_eos else "max_length", now)
+        elif parked:
+            req.phase = "prefilled"
+            self._prefilled[req.slot] = req
+            obs_emit("prefill_parked", request=req.id,
+                     prompt_len=req.prompt_len)
         else:
             req.phase = "active"
             self._active[req.slot] = req
@@ -2529,6 +2817,8 @@ class ServingEngine:
             del self._active[req.slot]
         if req.slot in self._prefilling and self._prefilling[req.slot] is req:
             del self._prefilling[req.slot]
+        if req.slot in self._prefilled and self._prefilled[req.slot] is req:
+            del self._prefilled[req.slot]
         req.chunk_cache = None  # a mid-prefill retiree drops its working
         req.phase = "finished"  # cache; pages/lane free below (no leak)
         if req.slot is not None:  # queued-expiry/cancel never held a slot

@@ -141,6 +141,42 @@ class ServingMetrics:
             "fleetx_serving_host_evicted_pages_total",
             "Host-tier entries dropped under the byte budget (LRU)")
         self._host_synced = (0, 0, 0)  # last (spilled, revived, evicted)
+        # disaggregated prefill/decode (docs/SERVING.md): the handoff
+        # counters — pages/bytes a prefill-role replica exported, pages a
+        # decode-role replica revived from a remote ship — plus the
+        # shared disk tier's traffic and the per-phase load signals
+        self._c_kv_shipped = counter(
+            "fleetx_serving_kv_pages_shipped_total",
+            "KV pages exported to a decode-role replica (export_kv)")
+        self._c_kv_bytes_shipped = counter(
+            "fleetx_serving_kv_bytes_shipped_total",
+            "Wire-format bytes of exported KV page payloads")
+        self._c_kv_revived_remote = counter(
+            "fleetx_serving_kv_pages_revived_remote_total",
+            "Shipped KV pages revived into this replica's pool "
+            "(submit(kv_payloads=...), no re-prefill)")
+        self._g_disk_bytes = gauge(
+            "fleetx_serving_disk_cache_bytes",
+            "Bytes of wire-format KV pages resident in the shared "
+            "disk tier (FLEETX_SERVING_DISK_CACHE_DIR)")
+        self._c_disk_hits = counter(
+            "fleetx_serving_disk_cache_hits_total",
+            "Disk-tier reads that revived a page (any replica wrote it)")
+        self._c_disk_misses = counter(
+            "fleetx_serving_disk_cache_misses_total",
+            "Disk-tier probes that found no stored page")
+        self._disk_synced = (0, 0)  # last (hits, misses)
+        self._g_queue_tokens = gauge(
+            "fleetx_serving_prefill_queue_tokens",
+            "Prompt tokens queued or mid-chunked-prefill — the load "
+            "signal the router prices a prefill-role replica by")
+        # info-style role family: 1 at the engine's serving role, so one
+        # scrape says which pool each replica belongs to
+        self._role_family = reg.gauge(
+            "fleetx_serving_role",
+            "1 at the engine's serving role (prefill | decode | both)",
+            ("engine", "role"))
+        self.role = "both"
         # speculative decoding (docs/SERVING.md): proposer/verifier
         # throughput — acceptance rate prices the proposer, tokens-per-
         # tick is the decode multiplier the whole feature exists for
@@ -357,6 +393,47 @@ class ServingMetrics:
                 child.inc(delta)
         self._host_synced = now
 
+    def set_role(self, role: str) -> None:
+        """Publish the engine's serving role (``prefill`` | ``decode`` |
+        ``both``) — the info-style label the router and a fleet scrape
+        read replica specialization from."""
+        self.role = role
+        labels = {"engine": self.engine_label, "role": role}
+        self._owned.append((self._role_family, dict(labels)))
+        self._role_family.labels(**labels).set(1)
+
+    def record_kv_shipped(self, pages: int, nbytes: int) -> None:
+        """One successful ``export_kv``: ``pages`` page payloads,
+        ``nbytes`` total wire-format bytes, left this replica for a
+        decode-role peer."""
+        self._c_kv_shipped.inc(int(pages))
+        self._c_kv_bytes_shipped.inc(int(nbytes))
+
+    def record_kv_revived_remote(self, pages: int) -> None:
+        """One ``submit(kv_payloads=...)`` admission revived ``pages``
+        shipped pages into this replica's pool (their prefill skipped —
+        the whole point of the handoff)."""
+        self._c_kv_revived_remote.inc(int(pages))
+
+    def observe_queue_tokens(self, tokens: int) -> None:
+        """Per-tick sample of queued + mid-chunk prompt tokens (the
+        prefill-phase load signal)."""
+        self._g_queue_tokens.set(int(tokens))
+
+    def observe_disk_tier(self, store) -> None:
+        """Per-tick sync from a :class:`DiskPageStore`: the bytes gauge
+        tracks the shared directory's current residency (every
+        replica's writes included), hit/miss counters advance by this
+        instance's lifetime deltas (registry counters only increment)."""
+        self._g_disk_bytes.set(store.nbytes)
+        now = (store.hits, store.misses)
+        last = self._disk_synced
+        for child, delta in zip((self._c_disk_hits, self._c_disk_misses),
+                                (now[0] - last[0], now[1] - last[1])):
+            if delta > 0:
+                child.inc(delta)
+        self._disk_synced = now
+
     def record_spec(self, proposed: int, accepted: int,
                     emitted_rows) -> None:
         """One speculative tick: ``proposed``/``accepted`` draft tokens
@@ -514,6 +591,31 @@ class ServingMetrics:
         return int(self._c_host_evicted.value)
 
     @property
+    def kv_pages_shipped(self) -> int:
+        """KV pages exported to decode-role replicas."""
+        return int(self._c_kv_shipped.value)
+
+    @property
+    def kv_bytes_shipped(self) -> int:
+        """Wire-format bytes of exported KV page payloads."""
+        return int(self._c_kv_bytes_shipped.value)
+
+    @property
+    def kv_pages_revived_remote(self) -> int:
+        """Shipped pages revived into this replica's pool."""
+        return int(self._c_kv_revived_remote.value)
+
+    @property
+    def disk_cache_hits(self) -> int:
+        """Disk-tier reads that revived a page."""
+        return int(self._c_disk_hits.value)
+
+    @property
+    def disk_cache_misses(self) -> int:
+        """Disk-tier probes that found nothing."""
+        return int(self._c_disk_misses.value)
+
+    @property
     def spec_proposed_tokens(self) -> int:
         """Draft tokens proposed to speculative verification."""
         return int(self._c_spec_proposed.value)
@@ -632,6 +734,18 @@ class ServingMetrics:
             "host_evicted_pages": self.host_evicted_pages,
             "host_cache_bytes": int(self._g_host_bytes.value),
             "host_cache_pages": int(self._g_host_pages.value),
+            # disaggregation story (docs/SERVING.md "Disaggregated
+            # prefill/decode"): what this replica shipped out / revived
+            # in, its role in the fleet, the prefill-phase load signal,
+            # and the shared disk tier's traffic
+            "role": self.role,
+            "kv_pages_shipped": self.kv_pages_shipped,
+            "kv_bytes_shipped": self.kv_bytes_shipped,
+            "kv_pages_revived_remote": self.kv_pages_revived_remote,
+            "prefill_queue_tokens": int(self._g_queue_tokens.value),
+            "disk_cache_bytes": int(self._g_disk_bytes.value),
+            "disk_cache_hits": self.disk_cache_hits,
+            "disk_cache_misses": self.disk_cache_misses,
             "page_occupancy_mean": (self._h_page_occ.mean or 0.0),
             "page_occupancy_peak": (self._h_page_occ.max or 0.0),
             # precision story (docs/QUANTIZATION.md): what the decode path

@@ -53,6 +53,26 @@ sampling streams are RNG-position-exact because the router re-sends
 the same per-request key. History tokens are never re-emitted through
 ``on_token`` — the client already has them.
 
+**Phase-disaggregated routing.** Replicas advertise a ``role`` in the
+same health report (``prefill`` / ``decode`` / ``both``); the router
+learns it at construction and refreshes it on every probe. Fresh
+prompts prefer PREFILL-role replicas — priced by their health report's
+``queue_tokens`` (prefill cost scales with prompt tokens, not request
+count) — which run chunked prefill to the first token and PARK. Each
+router tick then runs a HANDOFF phase: finished prefills export their
+KV pages as checksummed wire blobs (``export_kv``), the request
+re-queues at the head carrying the payloads, and the next dispatch
+lands it on a decode replica whose ``submit(kv_payloads=...)`` revives
+the shipped pages — decoding continues from the first token with no
+second prefill, byte-identical to a colocated run. Every failure in
+that chain (export fault, dead prefill replica, a decode replica
+rejecting a corrupt blob at the wire checksum) falls back to the
+replay ladder above: the first token is already in the durable
+history, so the request replays on any survivor — slower, never
+wrong. When no prefill replica is in rotation the fleet degrades to
+colocated dispatch; when no decode replica is reachable, prefill
+replicas serve as replay-decoders of last resort.
+
 **Graceful degradation.** Queued requests past their ``queue_ttl_s`` /
 ``deadline_s`` are shed with ``finish_reason="timeout"`` (partial
 tokens kept for migrated requests) instead of clogging the queue;
@@ -134,6 +154,10 @@ class _Replica:
     index: int
     engine: object
     state: str = ReplicaState.OK
+    # phase role learned from the health report ("prefill"/"decode"/
+    # "both"): prefill replicas get fresh prompts priced in queue
+    # TOKENS and are polled for finished prefills to hand off
+    role: str = "both"
     probe_failures: int = 0          # consecutive non-ok probes
     next_probe_tick: int = 0         # backoff schedule while suspect
     dispatched: Dict[int, int] = dataclasses.field(default_factory=dict)
@@ -165,6 +189,10 @@ class _RouterRequest:
     dispatches: int = 0
     first_token_time: Optional[float] = None
     tokens: List[int] = dataclasses.field(default_factory=list)
+    # disaggregated handoff: wire-format page blobs export_kv() shipped,
+    # consumed by the next dispatch (cleared on success OR on a decode-
+    # side ValueError — the replay fallback never re-sends bad blobs)
+    kv_payloads: Optional[list] = None
 
 
 class RouterMetrics:
@@ -348,7 +376,8 @@ class ServingRouter:
                  metrics: Optional[RouterMetrics] = None):
         if not replicas:
             raise ValueError("router needs at least one replica")
-        self._replicas = [_Replica(index=i, engine=e)
+        self._replicas = [_Replica(index=i, engine=e,
+                                   role=getattr(e, "role", "both"))
                           for i, e in enumerate(replicas)]
         self.max_queue = (max_queue if max_queue is not None
                           else _env_int("FLEETX_ROUTER_MAX_QUEUE", 0))
@@ -488,6 +517,7 @@ class ServingRouter:
         now = self._now()
         shed = self._shed_expired(now)
         self._probe_due()
+        handoff = self._handoff()
         dispatched = self._dispatch()
         finished, migrated = self._tick_replicas()
         stranded = self._strand_if_no_replicas()
@@ -495,7 +525,8 @@ class ServingRouter:
         self.metrics.observe_tick(len(self._queue), len(self._replicas),
                                   in_rotation)
         return {"dispatched": dispatched, "finished": finished,
-                "migrated": migrated, "shed": shed + stranded,
+                "migrated": migrated, "handoff": handoff,
+                "shed": shed + stranded,
                 "queue_depth": len(self._queue),
                 "in_rotation": in_rotation,
                 "replica_states": [r.state for r in self._replicas]}
@@ -623,6 +654,9 @@ class ServingRouter:
                 continue
             report = self._probe(rep)
             state = report.get("state", "dead")
+            # roles ride the health report so a cross-process router
+            # learns placement phases from the same /healthz scrape
+            rep.role = report.get("role", rep.role)
             if state == "ok":
                 if rep.state == ReplicaState.SUSPECT:
                     self._rejoin(rep)
@@ -734,9 +768,71 @@ class ServingRouter:
         self._queue = moved + self._queue
         return len(moved)
 
+    def _handoff(self) -> int:
+        """Disaggregated prefill→decode handoff (docs/SERVING.md): pull
+        every finished prefill off the in-rotation PREFILL-role
+        replicas, export its KV pages as wire blobs, and re-queue the
+        request at the HEAD carrying the payloads — the next dispatch
+        lands it on a decode replica that revives the pages instead of
+        re-prefilling. Export failure of any kind (fault injector,
+        replica error) falls back to the PR 8 replay ladder: the first
+        token is already in the durable router history, so the request
+        re-queues WITHOUT payloads and replays on a survivor — slower,
+        never wrong, zero tokens lost."""
+        moved = []
+        for rep in self._replicas:
+            if (rep.role != "prefill"
+                    or rep.state not in (ReplicaState.OK,
+                                         ReplicaState.DRAINING)):
+                continue
+            for erid in rep.engine.prefilled_ready():
+                rid = rep.dispatched.get(erid)
+                if rid is None:
+                    continue  # not ours (direct engine submit)
+                req = self._requests[rid]
+                try:
+                    req.kv_payloads = rep.engine.export_kv(erid)
+                except Exception as e:  # noqa: BLE001 — replay fallback
+                    obs_emit("kv_ship_failed", request=rid,
+                             replica=rep.index, where="export",
+                             error=f"{type(e).__name__}: {e}")
+                    logger.warning(
+                        "router: KV export of request %d failed on "
+                        "replica %d (%s); falling back to replay "
+                        "re-prefill", rid, rep.index, e)
+                    try:
+                        rep.engine.cancel(erid)
+                    except Exception:  # noqa: BLE001
+                        pass
+                # drop the engine-side stub result either way: export
+                # finalizes the parked copy as "prefilled", cancel as
+                # "cancelled" — the router copy is the live one now
+                try:
+                    rep.engine.take_result(erid)
+                except Exception:  # noqa: BLE001 — replica may be gone
+                    pass
+                rep.dispatched.pop(erid, None)
+                req.state = "queued"
+                req.replica = None
+                req.engine_rid = None
+                req.queued_since = self._now()
+                moved.append(req)
+                obs_emit("request_handoff", request=rid,
+                         replica=rep.index,
+                         shipped=req.kv_payloads is not None)
+        if moved:
+            moved.sort(key=lambda r: r.rid)
+            self._queue = moved + self._queue
+        return len(moved)
+
     def _load(self, rep: _Replica) -> float:
-        """Dispatch load score: what the health report prices — queued +
-        active work (a cross-process router uses its cached probe). A
+        """Dispatch load score: what the health report prices. Decode
+        and colocated replicas score queued + active work (slot
+        pressure); PREFILL-role replicas score queued prompt TOKENS —
+        prefill cost scales with tokens, not request count, so two
+        8-token prompts are cheaper than one 4096-token prompt even
+        though they are "two requests". Units never mix: placement
+        filters candidates to one role class before comparing. A
         raising ``health()`` between probes scores infinitely loaded —
         least preferred but never a router-wide crash; the next probe
         rotates the replica out properly."""
@@ -744,6 +840,8 @@ class ServingRouter:
             h = rep.engine.health()
         except Exception:  # noqa: BLE001 — sickness is the probe's call
             return float("inf")
+        if rep.role == "prefill":
+            return int(h.get("queue_tokens", 0))
         return int(h.get("queue_depth", 0)) + int(h.get("active", 0))
 
     def _pick_replica(self, req: _RouterRequest, exclude, loads):
@@ -754,12 +852,26 @@ class ServingRouter:
         in rotation (the queue waits). ``loads`` is this tick's score
         memo (one ``health()`` read per replica per tick, bumped per
         dispatch — the in-process version of scoring from the cached
-        probe scrape)."""
+        probe scrape).
+
+        Phase-aware placement (docs/SERVING.md "Disaggregated
+        prefill/decode"): requests carrying token history or shipped KV
+        need a replica that DECODES, so prefill-role replicas are only
+        used for them as a last resort (no other candidate — they can
+        replay-decode, just not divert-park an admit-with-history);
+        fresh prompts prefer prefill-role replicas when any are in
+        rotation, falling back to the full fleet when the prefill tier
+        is gone or saturated — degraded but never stuck."""
         candidates = [r for r in self._replicas
                       if r.state == ReplicaState.OK
                       and r.index not in exclude]
         if not candidates:
             return None, False
+        needs_decode = bool(req.tokens) or req.kv_payloads is not None
+        tier = [r for r in candidates
+                if (r.role != "prefill") == needs_decode]
+        if tier:
+            candidates = tier
         if req.affinity_key is not None:
             owner = self._affinity_map.get(req.affinity_key)
             for r in candidates:
@@ -826,7 +938,8 @@ class ServingRouter:
                 erid = rep.engine.submit(
                     req.prompt, on_token=self._make_cb(req),
                     rng_key=req.rng_key,
-                    history=req.tokens if req.tokens else None, **kw)
+                    history=req.tokens if req.tokens else None,
+                    kv_payloads=req.kv_payloads, **kw)
             except QueueFull:
                 only_refusals = False
                 exclude.add(rep.index)
@@ -839,17 +952,37 @@ class ServingRouter:
                 exclude.add(rep.index)
                 continue
             except ValueError as e:
+                if req.kv_payloads is not None:
+                    # the shipped pages failed decode-side validation
+                    # (wire checksum, page-size mismatch): drop the
+                    # blobs and retry THIS SAME candidate set as a
+                    # plain replay — the replica is healthy, the
+                    # payload was bad, and the history already covers
+                    # the prefill
+                    req.kv_payloads = None
+                    obs_emit("kv_ship_failed", request=req.rid,
+                             replica=rep.index, where="admit",
+                             error=f"{type(e).__name__}: {e}")
+                    logger.warning(
+                        "router: replica %d rejected shipped KV for "
+                        "request %d (%s); replaying without it",
+                        rep.index, req.rid, e)
+                    continue
                 # THIS replica can't legally admit it (e.g. a smaller
                 # survivor whose budget a migrated history exceeds on a
                 # heterogeneous fleet) — try the others before giving up
                 refused = e
                 exclude.add(rep.index)
                 continue
+            req.kv_payloads = None
             req.state = "dispatched"
             req.replica = rep.index
             req.engine_rid = erid
             req.dispatches += 1
-            loads[rep.index] = loads.get(rep.index, 0) + 1
+            # bump the memo in the replica's own load units: tokens
+            # for a prefill target, requests otherwise (_load docstring)
+            loads[rep.index] = loads.get(rep.index, 0) + (
+                int(req.prompt.size) if rep.role == "prefill" else 1)
             rep.dispatched[erid] = req.rid
             if req.affinity_key is not None:
                 self._affinity_map.setdefault(req.affinity_key, rep.index)

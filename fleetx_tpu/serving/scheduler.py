@@ -73,6 +73,11 @@ class Request:
     # with its tokens and recovery replay stays byte-identical
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # disaggregated serving (docs/SERVING.md): decoded page payloads a
+    # PREFILL-role replica shipped for this prompt — consumed (and
+    # cleared) by the engine's shipped-KV admission; a request whose
+    # shipped admission rolled back re-admits through the replay seam
+    kv_payloads: Any = dataclasses.field(default=None, repr=False)
 
     @property
     def prompt_len(self) -> int:
@@ -162,3 +167,11 @@ class FIFOScheduler:
     def queue_depth(self) -> int:
         """Requests waiting for a slot."""
         return len(self._queue)
+
+    def queued_tokens(self) -> int:
+        """Prompt tokens waiting in the queue — the load signal that
+        prices a PREFILL-role replica (prefill cost scales with tokens,
+        not request count; docs/SERVING.md "Disaggregated
+        prefill/decode"). The engine adds in-flight chunked-prefill
+        remainders on top."""
+        return sum(r.prompt_len for r in self._queue)

@@ -56,6 +56,7 @@ __all__ = [
     "TenantSpec",
     "TraceRequest",
     "WorkloadSpec",
+    "disagg_spec",
     "generate_trace",
     "run_trace",
     "score_goodput",
@@ -170,6 +171,27 @@ def generate_trace(spec: WorkloadSpec) -> List[TraceRequest]:
             abandon_s=tenant.abandon_s,
         ))
     return out
+
+
+def disagg_spec(n_requests: int = 32, *,
+                vocab: int = 50304,
+                prompt_len: Tuple[int, int] = (96, 192),
+                gen_len: Tuple[int, int] = (16, 64),
+                seed: int = 7) -> WorkloadSpec:
+    """The prefill-heavy mix phase disaggregation targets (docs/
+    SERVING.md "Disaggregated prefill/decode"): long prompts, short
+    decodes — the shape where an arriving prefill steals the most
+    decode ticks from in-flight requests on a colocated replica, and
+    where shipping KV to a dedicated decode replica pays for itself.
+    One tenant, no bursts, no SLOs: ``tools/bench_serving.py`` replays
+    the trace through colocated and disaggregated routers and asserts
+    byte parity, so the spec stays deliberately minimal (the goodput
+    machinery is exercised by the router_slo record instead)."""
+    return WorkloadSpec(
+        seed=seed, n_requests=n_requests, vocab=vocab,
+        arrival_rate=1000.0,  # effectively simultaneous arrivals
+        tenants=(TenantSpec("disagg", prompt_len=prompt_len,
+                            gen_len=gen_len),))
 
 
 def trace_hash(trace: List[TraceRequest]) -> str:

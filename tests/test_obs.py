@@ -361,8 +361,9 @@ def test_healthz_json_body_carries_rotate_out_reason():
         gen_cfg=GenerationConfig(decode_strategy="greedy",
                                  eos_token_id=10**6, pad_token_id=60,
                                  max_length=4))
-    assert eng.health() == {"state": "ok", "queue_depth": 0, "active": 0,
-                            "slots": 2}
+    assert eng.health() == {"state": "ok", "role": "both", "queue_depth": 0,
+                            "queue_tokens": 0, "active": 0, "slots": 2,
+                            "pages_in_use": 0, "usable_pages": 2}
     eng.submit(np.asarray([1, 2, 3], np.int32), max_length=4)
     assert eng.health()["queue_depth"] == 1
     srv = ObsServer(port=0).start()

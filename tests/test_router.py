@@ -174,6 +174,11 @@ def test_probe_escalation_marks_dead_and_migrates(tiny):
     assert router.metrics.snapshot()["probe_failures"] >= 3
 
 
+@pytest.mark.slow  # 39.9s (PR 16 tier-1 budget audit): the combined
+# churn is the belt-and-braces superset — each failure mode it mixes
+# keeps its own focused tier-1 gate (kill-failover parity, flap
+# rotate-out-and-back, probe escalation, bounded queue + deadline
+# shed), and the chaos CLI router scenarios drive the same mix e2e
 def test_conservation_under_kill_flap_and_saturation_churn(tiny):
     """THE conservation churn test (ISSUE 15 satellite): random bursts
     over a bounded router queue while replicas are killed and probes

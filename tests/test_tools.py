@@ -101,14 +101,17 @@ def test_bench_serving_records_schema(monkeypatch):
         want.append("gpt_345m_serving_mesh")
     want.append("gpt_345m_serving_page_sweep")
     want.append("gpt_345m_serving_router_slo")
+    want.append("gpt_345m_serving_disagg")
     assert [r["metric"] for r in recs] == want
     static, cont, shared, faulted, int8, chunked, spec = recs[:7]
     mesh = recs[7] if has_mesh else None
-    sweep = recs[-2]
-    router = recs[-1]
+    sweep = recs[-3]
+    router = recs[-2]
+    disagg = recs[-1]
     for r in recs:
-        if r["metric"] == "gpt_345m_serving_router_slo":
-            continue  # a goodput fraction, asserted separately below
+        if r["metric"] in ("gpt_345m_serving_router_slo",
+                           "gpt_345m_serving_disagg"):
+            continue  # router-level records, asserted separately below
         assert r["unit"] == "tokens/s"
         assert np.isfinite(r["value"]) and r["value"] > 0
         d = r["detail"]
@@ -229,6 +232,30 @@ def test_bench_serving_records_schema(monkeypatch):
     assert set(past["finish_reasons"]) <= {
         "eos", "max_length", "timeout", "rejected", "cache_full"}
     assert set(at["goodput_per_tenant"]) <= {"chat", "template"}
+    # the disaggregated record (docs/SERVING.md "Disaggregated
+    # prefill/decode"): 1P+1D byte-identical to 2 colocated replicas,
+    # real pages/bytes on the wire with every shipped page revived
+    # remotely, latency percentiles both ways, and the shared-disk
+    # sub-pass shows a FRESH replica sustaining the prefix hit rate
+    # out of the content-addressed store
+    assert disagg["unit"] == "tokens/s"
+    assert np.isfinite(disagg["value"]) and disagg["value"] > 0
+    d = disagg["detail"]
+    assert d["parity"] is True
+    assert d["n_prefill"] == 1 and d["n_decode"] == 1
+    assert d["kv_pages_shipped"] > 0 and d["kv_bytes_shipped"] > 0
+    assert 0 < d["kv_pages_revived_remote"] <= d["kv_pages_shipped"]
+    for side in ("colocated", "disagg"):
+        s = d[side]
+        assert s["ttft_ms_p99"] >= s["ttft_ms_p50"] > 0
+        assert s["tpot_ms_p99"] >= s["tpot_ms_p50"] > 0
+    dt = d["disk_tier"]
+    assert dt["parity"] is True
+    assert dt["fresh_replica_disk_hits"] > 0
+    assert dt["prefill_tokens_saved_fresh_replica"] > 0
+    assert dt["disk_cache_bytes"] > 0
+    assert (dt["prefix_hit_rate_fresh_replica"]
+            > dt["prefix_hit_rate_disk_off"])
 
 
 def test_pp_bubble_records_schema(monkeypatch, tmp_path):
@@ -381,6 +408,23 @@ def test_chaos_check_serving_spill_scenario(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 0, out
     assert "PASS serving_spill" in out
+
+
+@pytest.mark.slow  # ~15s; tier-1 covers the same contracts via
+def test_chaos_check_serving_disagg_scenario(tmp_path, capsys):
+    # tests/test_serving_disagg.py (export/admit parity, fallback
+    # ladder); this proves the CLI scenario end-to-end
+    """The phase-disaggregated chaos scenario (1 prefill + 1 decode
+    replica byte-identical to colocated, corrupt KV ship replayed to
+    parity, prefill replica killed mid-run and its requests replayed)
+    passes through the CLI driver."""
+    sys.path.insert(0, REPO)
+    import tools.chaos_check as cc
+
+    rc = cc.main(["--only", "serving_disagg", "--workdir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "PASS serving_disagg" in out
 
 
 @pytest.mark.slow  # ~35s; tier-1 covers the same contracts via

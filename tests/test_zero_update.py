@@ -156,6 +156,10 @@ def test_zero_update_spec_unit():
         P(("dp", "fsdp"))
 
 
+@pytest.mark.slow  # 27.7s (PR 16 tier-1 budget audit): heaviest
+# trainer gate; tier-1 keeps the spec/flag units here, the sentry
+# NaN-skip byte parity single-device (tests/test_resilience.py), and
+# this joins the mesh-matrix variants already behind the slow mark
 def test_zero_update_parity_and_sentry_dp(tmp_path, eight_devices,
                                           monkeypatch):
     """Tier-1 compact gate on the dp4 mesh: (a) 3-step final params match

@@ -820,6 +820,76 @@ def scenario_router_saturation(tmp):
             "alive after the storm")
 
 
+def scenario_serving_disagg(tmp):
+    """Disaggregated prefill/decode under fire: a prefill replica
+    killed mid-export AND a corrupted shipped page — both fall back to
+    the replay ladder, byte parity vs a clean colocated run holds, and
+    the ship/fallback events are banked."""
+    import numpy as np
+
+    from fleetx_tpu.obs import get_event_log
+    from fleetx_tpu.resilience.faults import faults
+    from fleetx_tpu.serving import ServingRouter
+
+    make, prompts = _serving_fixture()
+    clean, _, _ = _run_workload(make(True), prompts)
+
+    def run_router(router):
+        rids = [router.submit(p, max_length=8) for p in prompts]
+        res = router.drain(max_ticks=500)
+        assert len(res) == len(prompts), (
+            f"{len(prompts)} submitted, {len(res)} terminal results")
+        return [np.asarray(res[r].tokens) for r in rids]
+
+    # 1) clean disaggregated pass: 1 prefill + 1 decode == colocated
+    router = ServingRouter([make(True, role="prefill"),
+                            make(True, role="decode")], probe_every=1)
+    got = run_router(router)
+    assert all(np.array_equal(a, b) for a, b in zip(clean, got)), \
+        "disaggregated tokens diverged from colocated"
+    pre = router._replicas[0].engine
+    dec = router._replicas[1].engine
+    shipped = pre.metrics.kv_pages_shipped
+    assert shipped > 0 and dec.metrics.kv_pages_revived_remote == shipped
+    ev = get_event_log()
+    assert ev.find("kv_shipped"), "handoffs left no kv_shipped event"
+    assert ev.find("kv_revived_remote"), "no kv_revived_remote event"
+
+    # 2) corrupt one shipped page: the decode replica's wire checksum
+    #    rejects it at admit, the request replays — same bytes out
+    faults.configure(kv_ship_corrupt="1")
+    try:
+        got = run_router(ServingRouter(
+            [make(True, role="prefill"), make(True, role="decode")],
+            probe_every=1))
+    finally:
+        faults.reset()
+    assert all(np.array_equal(a, b) for a, b in zip(clean, got)), \
+        "corrupted ship diverged after replay fallback"
+    failed = ev.find("kv_ship_failed")
+    assert any(e.attrs.get("where") == "admit" for e in failed), \
+        "corrupt blob left no admit-side kv_ship_failed event"
+    assert ev.find("fault_injected", fault="kv_ship_corrupt")
+
+    # 3) kill the prefill replica mid-run: every parked/queued request
+    #    migrates to the decode replica and replays — zero tokens lost
+    faults.configure(replica_kill="0:3")
+    try:
+        got = run_router(ServingRouter(
+            [make(True, role="prefill"), make(True, role="decode")],
+            probe_every=1, probe_max_failures=1))
+    finally:
+        faults.reset()
+    assert all(np.array_equal(a, b) for a, b in zip(clean, got)), \
+        "prefill-replica kill diverged after migration replay"
+    assert ev.find("replica_dead", replica=0), "no replica_dead event"
+    n_fail = len(ev.find("kv_ship_failed"))
+    return (f"disaggregated 1P+1D byte-identical to colocated "
+            f"({shipped} pages shipped); corrupt ship + prefill kill "
+            f"both replayed to parity ({n_fail} kv_ship_failed "
+            "fallback(s) banked)")
+
+
 SCENARIOS = {
     "sentry": scenario_sentry,
     "sentry_zero": scenario_sentry_zero,
@@ -834,6 +904,7 @@ SCENARIOS = {
     "serving_spill": scenario_serving_spill,
     "router_kill": scenario_router_kill,
     "router_saturation": scenario_router_saturation,
+    "serving_disagg": scenario_serving_disagg,
 }
 
 
