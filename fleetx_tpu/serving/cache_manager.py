@@ -394,9 +394,18 @@ class DiskPageStore:
             return False
         path = self._path(key)
         tmp = path + f".tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-        os.replace(tmp, path)  # atomic: readers see old bytes or new
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)  # atomic: readers see old bytes or new
+        except OSError:
+            # full or read-only shared dir: the disk tier degrades to
+            # nothing-stored, it must never fault the serving tick
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return False
         self.spilled_pages += 1
         total = self.nbytes
         if total > self.capacity_bytes:
@@ -425,7 +434,17 @@ class DiskPageStore:
                 blob = f.read()
         except OSError:
             raise KeyError(key) from None
-        payload = HostPageStore.payload_from_bytes(blob)
+        try:
+            payload = HostPageStore.payload_from_bytes(blob)
+        except ValueError:
+            # self-heal: a corrupt entry must not outlive its first read,
+            # or every prompt matching this prefix would re-hit it
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            self.misses += 1  # a corrupt file reads as a (loud) miss
+            raise
         try:
             os.utime(path)
         except OSError:
@@ -436,8 +455,12 @@ class DiskPageStore:
 
     def pop(self, key):
         """Remove and return an entry's payload (explicit invalidation).
-        KeyError if absent."""
-        payload = self.get(key)
+        KeyError if absent — or corrupt: ``get`` unlinks the bad file,
+        so either way no entry remains afterwards."""
+        try:
+            payload = self.get(key)
+        except ValueError:
+            raise KeyError(key) from None
         self.hits -= 1  # a pop is not a cache hit
         self.revived_pages -= 1
         try:
@@ -895,7 +918,21 @@ class PagePool:
         # reference keeps the payload alive either way — the tier is
         # inclusive, see HostPageStore.get)
         host_keys = self._match_host(chunks, path)
-        payloads = [self.host_store.get(k) for k in host_keys]
+        payloads = []
+        for k in host_keys:
+            try:
+                payloads.append(self.host_store.get(k))
+            except (KeyError, ValueError):
+                # _match_host's membership check raced a sibling
+                # replica's eviction (KeyError) or the file failed its
+                # crc (ValueError — the disk store unlinks it): this key
+                # and every key after it (unattendable without it) read
+                # as misses and fall through to fresh prefill. The trie
+                # refs committed above stay valid either way, and the
+                # pool draw is unchanged (a revived page and a fresh
+                # page cost the same), so nothing needs unwinding.
+                break
+        host_keys = host_keys[:len(payloads)]
         row = self.tables[lane]
         row[:] = 0
         for i, n in enumerate(path):

@@ -198,8 +198,10 @@ def test_disk_store_lru_eviction_and_budget(tmp_path):
 
 
 def test_disk_store_corruption_and_atomicity(tmp_path):
-    """A file corrupted at rest raises ValueError at get (crc), and
-    writes leave no temp litter behind."""
+    """A file corrupted at rest raises ValueError at get (crc), writes
+    leave no temp litter behind, and the corrupt file SELF-HEALS: get
+    unlinks it so the entry reads as absent afterwards instead of
+    poisoning every later prompt that matches the prefix."""
     rng = np.random.RandomState(3)
     store = DiskPageStore(str(tmp_path), 1 << 20)
     key = ((7, 8),)
@@ -212,6 +214,134 @@ def test_disk_store_corruption_and_atomicity(tmp_path):
     open(path, "wb").write(bytes(raw))
     with pytest.raises(ValueError, match="crc32|corrupt"):
         store.get(key)
+    assert not os.path.exists(path), "corrupt file not unlinked by get"
+    assert key not in store  # self-healed: absent, not poisoned
+    assert store.hits == 0 and store.revived_pages == 0
+    # pop on a corrupt entry removes it too, surfacing plain KeyError
+    store.put(key, _payload(rng), 0)
+    raw = bytearray(open(path, "rb").read())
+    raw[-1] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(KeyError):
+        store.pop(key)
+    assert not os.path.exists(path)
+
+
+def test_disk_store_put_degrades_on_io_error(tmp_path):
+    """A full or read-only shared cache dir must degrade the disk tier
+    to nothing-stored (put returns False), never fault the caller:
+    TieredPageStore.put runs inside PagePool eviction, where an escaped
+    OSError would crash the serving tick into engine recovery."""
+    rng = np.random.RandomState(5)
+    store = DiskPageStore(str(tmp_path / "cache"), 1 << 20)
+    payload = _payload(rng)
+    os.rmdir(store.cache_dir)
+    open(store.cache_dir, "w").close()  # any write under it now fails
+    assert store.put(((1, 2),), payload, 0) is False
+    assert store.spilled_pages == 0
+    assert ((1, 2),) not in store
+    store.check_invariants()
+    # the tiered store keeps the page in DRAM when disk I/O fails
+    tiered = TieredPageStore(HostPageStore(1 << 20), store)
+    assert tiered.put(((1, 2),), payload, 64) is True
+    got = tiered.get(((1, 2),))
+    assert all(np.array_equal(x, y) for x, y in zip(payload, got))
+
+
+def _disk_pool(store, num_pages=5, page_size=4, lanes=3, lane_pages=4):
+    """PagePool spilling real wire-format payloads into a DiskPageStore,
+    with a revive journal (mirrors test_paged_serving._host_pool but
+    over the disk tier, so corruption/race behavior is end to end)."""
+    from fleetx_tpu.serving import PagePool
+
+    state = {"serial": 0, "revived": []}
+
+    def spill_fn(pages):
+        out = []
+        for _ in pages:
+            state["serial"] += 1
+            arr = np.full((2, 3), float(state["serial"]), np.float32)
+            out.append(([arr], arr.nbytes))
+        return out
+
+    def revive_fn(entries):
+        state["revived"].extend(entries)
+
+    pool = PagePool(num_pages, page_size, lanes, lane_pages,
+                    host_store=store, spill_fn=spill_fn,
+                    revive_fn=revive_fn)
+    return pool, state
+
+
+def _spill_prompt_to_disk(pool):
+    """Drive the deterministic spill lifecycle: register prompt A, park
+    it warm, pressure the pool so its two chunks spill to the disk
+    store, and return (A, key of chunk 1, key of chunk 2)."""
+    a = np.arange(1, 10, dtype=np.int32)     # 2 full chunks + tail
+    assert pool.alloc(0, a) == 0
+    pool.register_prefix(0, a)
+    pool.free(0)
+    b = np.arange(20, 33, dtype=np.int32)    # 4 fresh pages: spills A
+    assert pool.alloc(1, b) == 0
+    pool.free(1)
+    chunks = pool._chunks(a)
+    return a, (chunks[0],), (chunks[0], chunks[1])
+
+
+def test_alloc_corrupt_disk_entry_reads_as_miss(tmp_path):
+    """REGRESSION: a corrupt disk file under a matched prefix must NOT
+    escape PagePool.alloc after trie refs are committed (that crashed
+    the tick into engine recovery, and the un-unlinked file poison-
+    quarantined every prompt sharing the prefix). The key — and every
+    deeper key, unattendable without it — reads as a miss: alloc
+    succeeds with the surviving shallower revive plus fresh prefill,
+    and the bad file self-heals."""
+    store = DiskPageStore(str(tmp_path), 1 << 20)
+    pool, state = _disk_pool(store)
+    a, k1, k2 = _spill_prompt_to_disk(pool)
+    assert k1 in store and k2 in store
+    raw = bytearray(open(store._path(k2), "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(store._path(k2), "wb").write(bytes(raw))
+    state["revived"].clear()
+    shared = pool.alloc(2, a)            # must not raise
+    assert shared == 4, "chunk-1 revive should survive chunk-2 corruption"
+    assert len(state["revived"]) == 1
+    assert not os.path.exists(store._path(k2)), "corrupt file not healed"
+    pool.check_invariants()
+    # the lane is fully usable: the missed chunk re-prefills + registers
+    pool.register_prefix(2, a)
+    pool.free(2)
+    pool.check_invariants()
+
+
+def test_alloc_sibling_evicted_disk_entry_reads_as_miss(tmp_path):
+    """REGRESSION: the shared-dir TOCTOU — a sibling replica evicts the
+    file between _match_host's membership check and the read (KeyError
+    from get) — degrades to a plain full-fresh-prefill alloc, not an
+    exception out of the tick."""
+
+    class _RacingStore(DiskPageStore):
+        """Evicts ``vanish`` just before serving it — the sibling race,
+        made deterministic."""
+        vanish = None
+
+        def get(self, key):
+            if key == self.vanish:
+                os.remove(self._path(key))
+            return super().get(key)
+
+    store = _RacingStore(str(tmp_path), 1 << 20)
+    pool, state = _disk_pool(store)
+    a, k1, k2 = _spill_prompt_to_disk(pool)
+    store.vanish = k1                    # the FIRST matched key vanishes
+    state["revived"].clear()
+    shared = pool.alloc(2, a)            # must not raise
+    assert shared == 0 and not state["revived"]  # full fresh prefill
+    pool.check_invariants()
+    pool.register_prefix(2, a)
+    pool.free(2)
+    pool.check_invariants()
 
 
 def test_tiered_store_promotion(tmp_path):
