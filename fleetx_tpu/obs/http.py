@@ -35,13 +35,12 @@ external scraper does can perturb the data path.
 
 from __future__ import annotations
 
-import json
 import os
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 
 from fleetx_tpu.obs.events import get_event_log
+from fleetx_tpu.obs.httpd import HttpDaemon, JsonHandler
 from fleetx_tpu.obs.registry import get_registry
 from fleetx_tpu.obs.tracing import get_recorder
 
@@ -180,21 +179,12 @@ def snapshot_payload() -> Dict:
     }
 
 
-class _Handler(BaseHTTPRequestHandler):
-    """Request handler over the module-global registry/events/spans."""
+class _Handler(JsonHandler):
+    """Request handler over the module-global registry/events/spans
+    (``_send``/``_send_json``/silent logging come from the shared
+    :class:`~fleetx_tpu.obs.httpd.JsonHandler` base)."""
 
     server_version = "fleetx-obs/1"
-
-    def _send(self, code: int, body: bytes, ctype: str) -> None:
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _send_json(self, code: int, payload) -> None:
-        self._send(code, json.dumps(payload).encode(),
-                   "application/json; charset=utf-8")
 
     def do_GET(self) -> None:  # noqa: N802 — http.server contract
         """Route the four read-only endpoints (404 otherwise)."""
@@ -214,46 +204,15 @@ class _Handler(BaseHTTPRequestHandler):
                                   "endpoints": ["/metrics", "/snapshot",
                                                 "/trace", "/healthz"]})
 
-    def log_message(self, format, *args):  # noqa: A002 — http.server API
-        """Silence per-request stderr lines (scrapes every few seconds
-        would otherwise flood training logs)."""
 
-
-class ObsServer:
-    """The exposition server: daemon thread, started once, stoppable."""
+class ObsServer(HttpDaemon):
+    """The exposition server: daemon thread, started once, stoppable
+    (the shared :class:`~fleetx_tpu.obs.httpd.HttpDaemon` plumbing under
+    the obs routes)."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1"):
-        self._server = ThreadingHTTPServer((host, port), _Handler)
-        self._server.daemon_threads = True
-        self._thread: Optional[threading.Thread] = None
-        self.host = host
-
-    @property
-    def port(self) -> int:
-        """Actual bound port (resolves port-0 ephemeral binds)."""
-        return self._server.server_address[1]
-
-    @property
-    def url(self) -> str:
-        """Base URL of the running server."""
-        return f"http://{self.host}:{self.port}"
-
-    def start(self) -> "ObsServer":
-        """Serve on a daemon thread; returns self. Idempotent."""
-        if self._thread is None:
-            self._thread = threading.Thread(
-                target=self._server.serve_forever,
-                name="fleetx-obs-http", daemon=True)
-            self._thread.start()
-        return self
-
-    def stop(self) -> None:
-        """Shut the listener down and join the serving thread."""
-        self._server.shutdown()
-        self._server.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        super().__init__(_Handler, port=port, host=host,
+                         thread_name="fleetx-obs-http")
 
 
 _server_lock = threading.Lock()

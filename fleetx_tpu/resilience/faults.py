@@ -71,6 +71,22 @@ check):
   ``FLEETX_ROUTER_PROBE_MAX`` failures must rotate the replica out and
   back, never mark it dead).
 
+Cross-process RPC injection points (the serving front door's replica
+transport, docs/SERVING.md "Deployment"; indices count *attempted* RPC
+calls process-wide in the calling process, so a retried call consumes a
+fresh index):
+
+- ``FLEETX_FAULT_RPC_DROP``: the matching RPC attempts raise
+  :class:`RPCFault` INSTEAD of touching the network (a dropped
+  connection / dead replica process). The replica client maps the
+  failure onto the router's existing fallbacks: a dropped health probe
+  reads as a dead replica, a dropped step as ``ReplicaKilled`` →
+  migration, a dropped submit as a refusal the router routes around.
+- ``FLEETX_FAULT_RPC_DELAY`` / ``FLEETX_FAULT_RPC_DELAY_S``: sleep
+  ``FLEETX_FAULT_RPC_DELAY_S`` seconds before the matching RPC attempts
+  (congested network / slow replica — what RPC timeouts exist to
+  bound).
+
 Batch/step selectors share one grammar: a comma-separated list of
 entries, each either an int (``"3"``), or ``"N+"`` for every index >= N
 (``"0+"`` = always). :func:`raising_on_token` builds the deterministic
@@ -96,6 +112,7 @@ __all__ = [
     "KVShipFault",
     "PoisonFault",
     "PrefillFault",
+    "RPCFault",
     "ReplicaKilled",
     "TickFault",
     "faults",
@@ -136,6 +153,14 @@ class KVShipFault(RuntimeError):
     """Injected KV-export failure (FLEETX_FAULT_KV_SHIP_RAISE): the
     prefill-role replica died (or its transport did) mid-handoff — the
     router must fall back to replaying the request on a survivor."""
+
+
+class RPCFault(ConnectionError):
+    """Injected RPC transport failure (FLEETX_FAULT_RPC_DROP): the
+    request never reached the replica process (dropped connection, dead
+    peer). A ``ConnectionError`` subclass so the replica client's
+    network-failure mapping treats injected and real drops through one
+    code path."""
 
 
 class _Selector:
@@ -199,6 +224,9 @@ class FaultPlan:
     probe_flap: Optional[str] = None
     kv_ship_raise: Optional[str] = None
     kv_ship_corrupt: Optional[str] = None
+    rpc_drop: Optional[str] = None
+    rpc_delay: Optional[str] = None
+    rpc_delay_s: float = 0.05
 
     @classmethod
     def from_env(cls, env=os.environ) -> Optional["FaultPlan"]:
@@ -229,13 +257,16 @@ class FaultPlan:
             probe_flap=env.get("FLEETX_FAULT_PROBE_FLAP") or None,
             kv_ship_raise=env.get("FLEETX_FAULT_KV_SHIP_RAISE") or None,
             kv_ship_corrupt=env.get("FLEETX_FAULT_KV_SHIP_CORRUPT") or None,
+            rpc_drop=env.get("FLEETX_FAULT_RPC_DROP") or None,
+            rpc_delay=env.get("FLEETX_FAULT_RPC_DELAY") or None,
+            rpc_delay_s=_float("FLEETX_FAULT_RPC_DELAY_S", 0.05),
         )
         if not (plan.nan_batch or plan.data_raise_batch
                 or plan.data_slow_batch or plan.ckpt_save_step
                 or plan.tick_raise or plan.prefill_raise or plan.tick_hang
                 or plan.poison_request or plan.replica_kill
                 or plan.probe_flap or plan.kv_ship_raise
-                or plan.kv_ship_corrupt):
+                or plan.kv_ship_corrupt or plan.rpc_drop or plan.rpc_delay):
             return None
         return plan
 
@@ -246,7 +277,8 @@ class FaultInjector:
     _ZERO = {"nan": 0, "data_raise": 0, "data_slow": 0, "ckpt": 0,
              "tick_raise": 0, "prefill_raise": 0, "tick_hang": 0,
              "poison": 0, "replica_kill": 0, "probe_flap": 0,
-             "kv_ship_raise": 0, "kv_ship_corrupt": 0}
+             "kv_ship_raise": 0, "kv_ship_corrupt": 0,
+             "rpc_drop": 0, "rpc_delay": 0}
 
     def __init__(self):
         self._plan: Optional[FaultPlan] = None
@@ -254,9 +286,11 @@ class FaultInjector:
         self._tick_sel = self._prefill_sel = self._hang_sel = None
         self._poison_sel = None
         self._ship_raise_sel = self._ship_corrupt_sel = None
+        self._rpc_drop_sel = self._rpc_delay_sel = None
         self._kill_pending = set()   # {(replica, router_tick)} unfired
         self._flap_remaining = {}    # replica -> lying probes left
         self._batch_counter = 0
+        self._rpc_counter = 0
         self.injected = dict(self._ZERO)
 
     # ----------------------------------------------------------- configure
@@ -266,7 +300,8 @@ class FaultInjector:
             plan = FaultPlan(**{k: str(v) if v is not None
                                 and k.endswith(("batch", "step", "raise",
                                                 "hang", "request", "kill",
-                                                "flap", "corrupt")) else v
+                                                "flap", "corrupt", "drop",
+                                                "delay")) else v
                                 for k, v in kw.items()})
         def sel(field):
             spec = getattr(plan, field, None) if plan else None
@@ -290,6 +325,8 @@ class FaultInjector:
         self._poison_sel = sel("poison_request")
         self._ship_raise_sel = sel("kv_ship_raise")
         self._ship_corrupt_sel = sel("kv_ship_corrupt")
+        self._rpc_drop_sel = sel("rpc_drop")
+        self._rpc_delay_sel = sel("rpc_delay")
         kill = getattr(plan, "replica_kill", None) if plan else None
         flap = getattr(plan, "probe_flap", None) if plan else None
         self._kill_pending = set(
@@ -297,6 +334,7 @@ class FaultInjector:
         self._flap_remaining = dict(
             _parse_pairs(flap, "FLEETX_FAULT_PROBE_FLAP") if flap else ())
         self._batch_counter = 0
+        self._rpc_counter = 0
         self.injected = dict(self._ZERO)
 
     def configure_from_env(self, env=os.environ) -> None:
@@ -431,6 +469,32 @@ class FaultInjector:
                      attempt=attempt)
             return True
         return False
+
+    def on_rpc(self, method: str) -> None:
+        """Cross-process RPC fault seam, called by the replica client
+        before every HTTP call it issues. Indices count attempted RPCs
+        process-wide (``method`` only labels the event). Sleeps
+        ``rpc_delay_s`` when the delay selector matches, then raises
+        :class:`RPCFault` when the drop selector matches — delay-then-
+        drop models a connection that stalls before dying."""
+        if self._plan is None:
+            return
+        if self._rpc_drop_sel is None and self._rpc_delay_sel is None:
+            return
+        i = self._rpc_counter
+        self._rpc_counter += 1
+        if self._rpc_delay_sel and i in self._rpc_delay_sel:
+            self.injected["rpc_delay"] += 1
+            obs_emit("fault_injected", fault="rpc_delay", attempt=i,
+                     method=method)
+            time.sleep(self._plan.rpc_delay_s)
+        if self._rpc_drop_sel and i in self._rpc_drop_sel:
+            self.injected["rpc_drop"] += 1
+            obs_emit("fault_injected", fault="rpc_drop", attempt=i,
+                     method=method)
+            raise RPCFault(
+                f"injected RPC drop at attempt {i} (method {method!r}, "
+                "FLEETX_FAULT_RPC_DROP)")
 
     def on_router_tick(self, replica: int, tick: int) -> None:
         """Raise :class:`ReplicaKilled` when the router is about to tick

@@ -1,0 +1,42 @@
+"""Deployable serving front door: OpenAI-compatible API + replica RPC.
+
+This package turns the in-process serving stack (``ServingEngine`` +
+``ServingRouter``) into something an operator can actually put on a
+port (docs/SERVING.md "Deployment"):
+
+- :mod:`~fleetx_tpu.serving.api.server` — ``ApiServer``, a stdlib-only
+  OpenAI-compatible HTTP front door (``/v1/chat/completions``,
+  ``/v1/completions``, ``/v1/models``) with SSE streaming driven off
+  the engine/router ``on_token`` callbacks.
+- :mod:`~fleetx_tpu.serving.api.replica_server` /
+  :mod:`~fleetx_tpu.serving.api.replica_client` — the cross-process
+  replica RPC: each replica process serves its engine over HTTP, the
+  router process drives engine-shaped client proxies, and every network
+  failure maps onto the router's existing dead-replica / zero-token-loss
+  replay fallbacks.
+- :mod:`~fleetx_tpu.serving.api.wire` — the JSON codecs (RNG keys, KV
+  page blobs, results, typed errors) both sides share.
+
+``tools/serve.py`` is the launcher that composes these into a fleet:
+N replica processes behind one router + API process.
+
+Imports here stay lazy: the submodules pull jax/the engine, and the
+launcher imports this package before deciding which role a process
+plays.
+"""
+
+__all__ = ["ApiServer", "ReplicaClient", "ReplicaServer"]
+
+
+def __getattr__(name):
+    """Lazy re-exports (keep ``import fleetx_tpu.serving.api`` cheap)."""
+    if name == "ApiServer":
+        from fleetx_tpu.serving.api.server import ApiServer
+        return ApiServer
+    if name == "ReplicaClient":
+        from fleetx_tpu.serving.api.replica_client import ReplicaClient
+        return ReplicaClient
+    if name == "ReplicaServer":
+        from fleetx_tpu.serving.api.replica_server import ReplicaServer
+        return ReplicaServer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

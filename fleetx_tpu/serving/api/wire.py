@@ -1,0 +1,212 @@
+"""JSON wire codecs + HTTP transport for the cross-process replica RPC.
+
+The router ⇄ replica contract (docs/SERVING.md "Deployment") rides
+plain JSON over HTTP so any side can be curl-debugged. Three payload
+families need codecs beyond JSON primitives:
+
+- **RNG keys** — the router pins each request's sampling stream to one
+  ``jax.random`` key and re-sends the SAME key at every migration
+  (RNG-position-exact failover). The key's raw ``uint32`` words
+  round-trip losslessly through a JSON int list, so seeded sampling is
+  byte-identical across the process boundary.
+- **KV page blobs** — ``export_kv`` ships crc32-trailed
+  ``HostPageStore.payload_to_bytes`` v2 wire bytes; they cross HTTP
+  base64-encoded, UNPARSED — the decode replica's ``submit`` is the one
+  place that validates the checksum, same as in-process.
+- **Results** — ``ServingResult`` flattens to a dict (arrays →
+  lists) and rebuilds on the client, so the router's ``drain()`` hands
+  back the same dataclass either way.
+
+Errors cross as ``{"error_kind": ..., "error": ...}`` bodies with a
+4xx/5xx status; :func:`raise_for_kind` rebuilds the typed exception
+(``QueueFull``, ``ShuttingDown``, ``ValueError``, ...) so the router's
+existing except-clauses fire identically for a remote replica.
+
+:func:`rpc_call` is the one transport function: POST/GET with a
+timeout, the ``faults.on_rpc`` chaos seam in front, and every network
+failure normalized to ``ConnectionError`` — the replica client maps
+that onto the router's dead-replica/replay fallbacks.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from fleetx_tpu.resilience.faults import faults
+
+__all__ = [
+    "b64_blobs_decode",
+    "b64_blobs_encode",
+    "raise_for_kind",
+    "result_from_wire",
+    "result_to_wire",
+    "rng_key_from_wire",
+    "rng_key_to_wire",
+    "rpc_call",
+]
+
+
+def rng_key_to_wire(rng_key) -> Optional[List[int]]:
+    """A jax PRNG key as a JSON-safe list of uint32 words (None passes
+    through). Typed (new-style) keys flatten through their raw key
+    data; raw ``uint32`` key arrays pass as-is — both reconstruct to
+    the RAW layout :func:`rng_key_from_wire` returns."""
+    if rng_key is None:
+        return None
+    import jax
+
+    try:
+        arr = np.asarray(rng_key)
+        if arr.dtype != np.uint32:
+            raise TypeError(f"not a raw key array ({arr.dtype})")
+    except TypeError:  # a typed key (opaque dtype): flatten its data
+        arr = np.asarray(jax.random.key_data(rng_key))
+    return [int(x) for x in arr.reshape(-1)]
+
+
+def rng_key_from_wire(words) -> Optional[object]:
+    """Rebuild the raw ``uint32`` key array a wire list encodes (None
+    passes through). The engine's sampling path accepts raw key arrays,
+    and uint32 ints round-trip JSON exactly — so the remote stream is
+    bit-identical to the in-process one."""
+    if words is None:
+        return None
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.asarray(words, np.uint32))
+
+
+def b64_blobs_encode(blobs) -> Optional[List[str]]:
+    """KV page wire blobs (bytes) → base64 strings (None passes
+    through). The crc32 trailer travels inside the blob untouched."""
+    if blobs is None:
+        return None
+    return [base64.b64encode(bytes(b)).decode("ascii") for b in blobs]
+
+
+def b64_blobs_decode(items) -> Optional[List[bytes]]:
+    """Base64 strings → the original wire blobs, still UNVALIDATED —
+    ``submit(kv_payloads=...)`` owns the checksum check, so a corrupt
+    ship fails exactly where the in-process path fails."""
+    if items is None:
+        return None
+    return [base64.b64decode(s) for s in items]
+
+
+def result_to_wire(res) -> Optional[Dict]:
+    """``ServingResult`` → JSON dict (None while in flight)."""
+    if res is None:
+        return None
+    return {
+        "id": int(res.id),
+        "prompt": [int(t) for t in np.asarray(res.prompt).reshape(-1)],
+        "tokens": [int(t) for t in np.asarray(res.tokens).reshape(-1)],
+        "finish_reason": str(res.finish_reason),
+        "ttft_s": float(res.ttft_s),
+        "latency_s": float(res.latency_s),
+    }
+
+
+def result_from_wire(d: Optional[Dict]):
+    """JSON dict → ``ServingResult`` (None passes through)."""
+    if d is None:
+        return None
+    from fleetx_tpu.serving.engine import ServingResult
+
+    return ServingResult(
+        id=int(d["id"]),
+        prompt=np.asarray(d["prompt"], np.int32),
+        tokens=np.asarray(d["tokens"], np.int32),
+        finish_reason=str(d["finish_reason"]),
+        ttft_s=float(d["ttft_s"]),
+        latency_s=float(d["latency_s"]),
+    )
+
+
+# error_kind strings ↔ the exceptions the router's fallbacks key on
+_KIND_TO_EXC = None
+
+
+def _kinds():
+    """Lazy error-kind table (serving.engine imports jax — keep the
+    wire module importable without pulling the engine first)."""
+    global _KIND_TO_EXC
+    if _KIND_TO_EXC is None:
+        from fleetx_tpu.serving.engine import (
+            QueueFull,
+            RecoveryExhausted,
+            ShuttingDown,
+        )
+
+        _KIND_TO_EXC = {
+            "queue_full": QueueFull,
+            "shutting_down": ShuttingDown,
+            "recovery_exhausted": RecoveryExhausted,
+            "value_error": ValueError,
+            "key_error": KeyError,
+        }
+    return _KIND_TO_EXC
+
+
+def kind_for_exception(exc) -> str:
+    """The wire ``error_kind`` for an exception the replica raised
+    (unknown types cross as ``"internal"`` — the client surfaces them
+    as ``RuntimeError``, which the router treats as a sick replica)."""
+    for kind, cls in _kinds().items():
+        if isinstance(exc, cls):
+            return kind
+    return "internal"
+
+
+def raise_for_kind(kind: str, message: str) -> None:
+    """Re-raise the typed exception an ``error_kind`` body encodes, so
+    the router's except-clauses (``QueueFull`` → try another replica,
+    ``ValueError`` → drop shipped KV / exclude, ``RecoveryExhausted``
+    → mark dead) behave identically across the process boundary."""
+    exc = _kinds().get(kind, RuntimeError)
+    raise exc(message)
+
+
+def rpc_call(url: str, payload: Optional[Dict] = None, *,
+             timeout_s: float = 10.0, method: str = "rpc") -> Dict:
+    """One RPC: POST ``payload`` as JSON (GET when None) to ``url``,
+    return the parsed JSON body. The ``faults.on_rpc`` chaos seam runs
+    first (drop/delay injection). An ``error_kind`` body re-raises its
+    typed exception regardless of status code; transport-level failures
+    (refused, reset, timeout, DNS) normalize to ``ConnectionError`` so
+    callers have ONE network-failure type to map onto the router's
+    dead-replica fallbacks."""
+    faults.on_rpc(method)
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            body = json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        # a structured replica-side error (4xx/5xx with a JSON body)
+        try:
+            body = json.loads(e.read() or b"{}")
+        except (ValueError, OSError):
+            raise ConnectionError(
+                f"rpc {method} to {url}: HTTP {e.code} with no JSON body")
+        if isinstance(body, dict) and "error_kind" in body:
+            raise_for_kind(body["error_kind"], body.get("error", ""))
+        # a JSON body WITHOUT error_kind on a non-200 is data, not an
+        # error: /healthz serves 503 with the draining/dead health dict,
+        # and the probe needs that body (draining ≠ dead)
+        return body
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        # refused/reset/timeout — the replica process is unreachable
+        raise ConnectionError(
+            f"rpc {method} to {url} failed: {type(e).__name__}: {e}")
+    if isinstance(body, dict) and "error_kind" in body:
+        raise_for_kind(body["error_kind"], body.get("error", ""))
+    return body

@@ -90,6 +90,14 @@ def _clean_stream(tiny, prompt, max_length=8):
 # ------------------------------------------------------------- failover
 
 
+@pytest.mark.slow  # 18.1s (PR 17 tier-1 budget audit): the same
+# zero-token-loss migration contract stays tier-1 via
+# test_serving_api.py::test_rpc_router_byte_parity_and_migration (the
+# identical router dead-replica path driven by a real replica-server
+# death, asserting byte parity + callback-stream conservation +
+# exactly-one-result + replica_dead/request_migrated events); the
+# FLEETX_FAULT_REPLICA_KILL injector itself stays covered by the
+# chaos_check router_kill scenario and the slow conservation churn.
 def test_replica_kill_failover_byte_parity(tiny):
     """THE chaos gate (ISSUE 15): a replica killed mid-burst on a
     3-replica router — every request reaches exactly one terminal

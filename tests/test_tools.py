@@ -72,6 +72,15 @@ def test_precompute_text_embeddings_hash(tmp_path):
     assert np.all(embeds[0][3:] == 0)
 
 
+@pytest.mark.slow  # 84.8s baseline (PR 17 tier-1 budget audit): the
+# full bench mode-matrix (static/continuous/shared-prefix/faulted/int8/
+# chunked/spec/mesh/sweep/router/disagg) re-runs every serving mode.
+# The record envelope + harness + parity contract stays tier-1 via
+# test_bench_serving_http_record_schema (same _model/_workload/
+# _run_continuous substrate, same schema shape), and each mode's
+# underlying engine contract has its own tier-1 suite (test_serving,
+# test_chunked_serving, test_spec_serving, test_quantized_serving,
+# test_mesh_serving, test_router, test_serving_disagg).
 def test_bench_serving_records_schema(monkeypatch):
     """Serving bench on the tiny CPU config: static, continuous,
     shared-prefix, faulted, int8, and (env-gated) page-sweep modes all
@@ -256,6 +265,36 @@ def test_bench_serving_records_schema(monkeypatch):
     assert dt["disk_cache_bytes"] > 0
     assert (dt["prefix_hit_rate_fresh_replica"]
             > dt["prefix_hit_rate_disk_off"])
+
+
+def test_bench_serving_http_record_schema(monkeypatch):
+    """The --http bench record (tiny CPU config): the continuous
+    workload served through real RPC replica servers + router + the
+    OpenAI SSE API banks ``gpt_345m_serving_http`` with byte parity vs
+    the in-process engine asserted, both sides' TTFT/throughput in
+    detail, and the fleet shape recorded. This is the tier-1 gate for
+    the bench record envelope and the _model/_workload/_run_continuous
+    harness (the full mode matrix is slow-marked above)."""
+    monkeypatch.setenv("BENCH_SERVING_TINY", "1")
+    sys.path.insert(0, REPO)
+    import tools.bench_serving as bs
+
+    bs = importlib.reload(bs)  # re-read the _TINY env gate
+    rec = bs.http_record(n_requests=4, slots=2)
+    assert rec["metric"] == "gpt_345m_serving_http"
+    assert rec["unit"] == "tokens/s"
+    assert np.isfinite(rec["value"]) and rec["value"] > 0
+    assert rec["vs_baseline"] is None
+    d = rec["detail"]
+    assert d["requests"] == 4 and d["slots"] == 2 and d["replicas"] == 2
+    assert d["parity"] is True
+    assert d["useful_tokens"] > 0 and d["elapsed_s"] > 0
+    assert d["ttft_ms_p95"] >= d["ttft_ms_p50"] > 0
+    assert np.isfinite(d["ttft_ms_mean"])
+    # the in-process baseline rides along so the record prices the
+    # HTTP/RPC serving tax
+    assert np.isfinite(d["inproc_tokens_per_s"]) and d["inproc_tokens_per_s"] > 0
+    assert d["inproc_ttft_ms_p50"] > 0 and d["inproc_elapsed_s"] > 0
 
 
 def test_pp_bubble_records_schema(monkeypatch, tmp_path):

@@ -92,7 +92,14 @@ window can pick a DMA-tuned default over the correctness-tuned 16
 (ROADMAP item 1 follow-up); per-size tokens/s + TTFT ride
 `detail.sweep`, `value` is the best size's tokens/s.
 
-Standalone:  python tools/bench_serving.py
+`--http` (or `http_record()` in-process) banks the separate
+`gpt_345m_serving_http` record instead: the continuous workload served
+through the deployable front door (replica RPC servers + router-over-
+RPC + OpenAI-compatible SSE API, the `tools/serve.py` shape) with
+byte parity vs the in-process engine asserted — the record's delta
+against the in-process pass IS the HTTP/RPC serving tax.
+
+Standalone:  python tools/bench_serving.py [--http]
 In-process:  from tools.bench_serving import serving_records
 """
 
@@ -1098,6 +1105,127 @@ def serving_records(n_requests: int = N_REQUESTS, slots: int = SLOTS):
     return records
 
 
+def http_record(n_requests: int = N_REQUESTS, slots: int = SLOTS,
+                replicas: int = 2):
+    """The ``gpt_345m_serving_http`` record: the continuous workload
+    served through the DEPLOYABLE front door — per-replica RPC servers,
+    a router over :class:`ReplicaClient` proxies, and the OpenAI-
+    compatible SSE API on top (the ``tools/serve.py`` fleet shape, all
+    in-process threads here so the record is hermetic) — with byte
+    parity vs the in-process engine ASSERTED per request. ``detail``
+    carries both sides' TTFT and tokens/s; the delta is the HTTP/RPC
+    serving tax. Note the fleet runs ``replicas × slots`` lanes vs the
+    baseline's ``slots``, so tokens/s is the fleet-shape number, not an
+    apples-to-apples single-engine overhead."""
+    import concurrent.futures
+    import urllib.request
+
+    import jax
+
+    from fleetx_tpu.models.gpt.generation import GenerationConfig
+    from fleetx_tpu.serving import ServingEngine
+    from fleetx_tpu.serving.api.replica_client import ReplicaClient
+    from fleetx_tpu.serving.api.replica_server import ReplicaServer
+    from fleetx_tpu.serving.api.server import ApiServer
+    from fleetx_tpu.serving.router import ServingRouter
+
+    model = _model()
+    workload = _workload(n_requests)
+    variables = jax.jit(model.init)(
+        jax.random.PRNGKey(0),
+        np.zeros((1, PROMPT_RANGE[1]), np.int32),
+    )
+    gen_cfg = GenerationConfig(decode_strategy="greedy", eos_token_id=-1,
+                               pad_token_id=0, max_length=GEN_RANGE[1])
+
+    def make_engine():
+        return ServingEngine(model, variables, slots=slots,
+                             cache_len=model.cfg.max_position_embeddings,
+                             gen_cfg=gen_cfg,
+                             prefill_bucket=8 if _TINY else 32)
+
+    # in-process reference: the parity source and the overhead baseline
+    engine = make_engine()
+    _run_continuous(engine, workload)  # compile warmup
+    base_toks, base_elapsed, base_detail = _run_continuous(engine, workload)
+
+    servers = [ReplicaServer(make_engine()).start() for _ in range(replicas)]
+    api = None
+    try:
+        clients = [ReplicaClient(s.url, connect_wait_s=10)
+                   for s in servers]
+        api = ApiServer(ServingRouter(clients),
+                        model_id="fleetx-bench").start()
+
+        def one(item):
+            i, (prompt, gen) = item
+            req = urllib.request.Request(
+                api.url + "/v1/completions",
+                json.dumps({"prompt": [int(t) for t in prompt],
+                            "max_tokens": int(gen),
+                            "stream": True}).encode(),
+                {"Content-Type": "application/json"})
+            t_submit = time.perf_counter()
+            ttft, toks = None, []
+            with urllib.request.urlopen(req, timeout=600) as resp:
+                for line in resp:
+                    line = line.decode().strip()
+                    if (not line.startswith("data: ")
+                            or line[6:] == "[DONE]"):
+                        continue
+                    chunk = json.loads(line[6:])
+                    if "token" in chunk:
+                        if ttft is None:
+                            ttft = time.perf_counter() - t_submit
+                        toks.append(chunk["token"])
+            return i, toks, ttft
+
+        def sweep():
+            out = [None] * len(workload)
+            ttfts = [0.0] * len(workload)
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=len(workload)) as pool:
+                for i, toks, ttft in pool.map(one, enumerate(workload)):
+                    out[i] = toks
+                    ttfts[i] = ttft if ttft is not None else 0.0
+            return out, time.perf_counter() - t0, ttfts
+
+        sweep()  # warmup: compiles every replica engine's decode path
+        http_toks, elapsed, ttfts = sweep()
+    finally:
+        if api is not None:
+            api.stop()
+        for s in servers:
+            s.stop()
+
+    parity = all(
+        np.array_equal(np.asarray(a, np.int32), np.asarray(b, np.int32))
+        for a, b in zip(base_toks, http_toks))
+    assert parity, ("HTTP-served tokens diverged from the in-process "
+                    "engine — the front door corrupted a stream")
+    useful = sum(g for _, g in workload)
+    detail = {
+        "requests": len(workload),
+        "slots": slots,
+        "replicas": replicas,
+        "useful_tokens": useful,
+        "elapsed_s": round(elapsed, 3),
+        "parity": parity,
+        **_ttft_stats(ttfts),
+        "inproc_tokens_per_s": round(useful / base_elapsed, 1),
+        "inproc_ttft_ms_p50": base_detail["ttft_ms_p50"],
+        "inproc_elapsed_s": round(base_elapsed, 3),
+    }
+    return {
+        "metric": "gpt_345m_serving_http",
+        "value": round(useful / elapsed, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "detail": detail,
+    }
+
+
 if __name__ == "__main__":
     from fleetx_tpu.utils.device_guard import acquire_devices_or_die
 
@@ -1107,5 +1235,8 @@ if __name__ == "__main__":
         int(os.environ.get("BENCH_INIT_TIMEOUT", 300)), label="bench_serving",
         platform_override=os.environ.get("BENCH_PLATFORM") or None,
     )
-    for rec in serving_records():
-        print(json.dumps(rec))
+    if "--http" in sys.argv[1:]:
+        print(json.dumps(http_record()))
+    else:
+        for rec in serving_records():
+            print(json.dumps(rec))

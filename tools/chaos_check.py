@@ -58,7 +58,13 @@ per scenario, non-zero exit on any failure:
   with ``QueueFull``, expired queued requests shed as
   ``finish_reason="timeout"``, every accepted request still reaches
   exactly one terminal result, and the router keeps serving afterwards
-  (never collapses).
+  (never collapses);
+- ``serving_http``: a REAL replica subprocess (``tools/serve.py``
+  worker) is SIGKILLed while an OpenAI-compatible SSE stream is mid-
+  flight: the front door's stream completes through the router's
+  cross-process RPC migration, byte-identical to a clean in-process
+  engine — zero tokens lost or duplicated — and ``replica_dead`` +
+  ``request_migrated`` events are banked.
 
 Usage::
 
@@ -890,6 +896,82 @@ def scenario_serving_disagg(tmp):
             "fallback(s) banked)")
 
 
+def scenario_serving_http(tmp):
+    """A replica PROCESS SIGKILLed mid-SSE-stream: the OpenAI front
+    door's stream completes through router migration over the replica
+    RPC — byte-identical to a clean in-process engine, zero tokens
+    lost or duplicated."""
+    import json
+    import urllib.request
+
+    from fleetx_tpu.obs import get_event_log
+    from fleetx_tpu.serving import ServingRouter
+    from fleetx_tpu.serving.api.replica_client import ReplicaClient
+    from fleetx_tpu.serving.api.server import ApiServer
+    from tools.serve import _build_demo_engine, _spawn_replicas
+
+    os.makedirs(tmp, exist_ok=True)
+    gen_len = 20
+    # clean reference: the same demo engine serve.py replicas build
+    eng = _build_demo_engine(0)
+    rid = eng.submit([1, 2, 3], max_length=gen_len)
+    clean = [int(t) for t in eng.drain()[rid].tokens]
+    assert len(clean) == gen_len
+
+    procs, urls = _spawn_replicas(2, grace_s=5.0, tmpdir=tmp)
+    api = None
+    try:
+        clients = [ReplicaClient(u, connect_wait_s=60) for u in urls]
+        router = ServingRouter(clients, probe_every=1)
+        api = ApiServer(router, model_id="fleetx-demo").start()
+        req = urllib.request.Request(
+            api.url + "/v1/chat/completions",
+            json.dumps({"model": "fleetx-demo", "stream": True,
+                        "max_tokens": gen_len,
+                        "messages": [{"role": "user",
+                                      "content": "1 2 3"}]}).encode(),
+            {"Content-Type": "application/json"})
+        toks, finish, killed = [], None, None
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            for line in resp:
+                line = line.decode().strip()
+                if not line.startswith("data: ") or line[6:] == "[DONE]":
+                    continue
+                chunk = json.loads(line[6:])
+                if "token" in chunk:
+                    toks.append(chunk["token"])
+                if chunk["choices"][0]["finish_reason"]:
+                    finish = chunk["choices"][0]["finish_reason"]
+                if len(toks) == 3 and killed is None:
+                    # find the replica actually decoding this stream and
+                    # SIGKILL its whole process mid-flight
+                    for i, c in enumerate(clients):
+                        if c.health().get("active", 0) > 0:
+                            killed = i
+                            procs[i].kill()
+                            break
+                    assert killed is not None, "no replica was active"
+        assert killed is not None, "stream finished before the kill fired"
+        assert toks == clean, (
+            f"stream diverged after replica-process kill: {toks} != {clean}"
+            " (token lost or duplicated)")
+        assert finish == "length", finish
+        ev = get_event_log()
+        assert ev.find("replica_dead", replica=killed), \
+            "process kill left no replica_dead event"
+        assert ev.find("request_migrated"), "no request_migrated event"
+    finally:
+        if api is not None:
+            api.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=30)
+    return (f"replica process {killed} SIGKILLed after 3 tokens; SSE "
+            f"stream completed {len(toks)}/{gen_len} tokens byte-"
+            "identical through RPC migration (zero loss/dup)")
+
+
 SCENARIOS = {
     "sentry": scenario_sentry,
     "sentry_zero": scenario_sentry_zero,
@@ -905,6 +987,7 @@ SCENARIOS = {
     "router_kill": scenario_router_kill,
     "router_saturation": scenario_router_saturation,
     "serving_disagg": scenario_serving_disagg,
+    "serving_http": scenario_serving_http,
 }
 
 
