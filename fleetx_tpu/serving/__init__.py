@@ -20,8 +20,12 @@ restores the fixed per-slot cache).
 Layout: ``cache_manager`` (page pool + prefix trie + slot-compat cache,
 and the no-zeroing live-window safety argument), ``scheduler`` (FIFO
 admission policy seam), ``engine`` (submit/step/drain loop + jitted
-prefill/decode), ``metrics`` (queue/TTFT/throughput/prefix-reuse
-observability), ``router`` (N-replica dispatch, health-based failover,
+prefill/decode), ``model_protocol`` (the model-agnostic serving
+contract: executor seam + capability flags + the router-facing engine
+surface), ``batch_engine`` / ``ernie_engine`` / ``embedding_engine``
+(KV-free dynamic-batching engines for encoder-style models), ``metrics``
+(queue/TTFT/throughput/prefix-reuse observability), ``router``
+(N-replica dispatch with per-model groups, health-based failover,
 zero-token-loss migration), ``workload`` (seeded trace generation + the
 SLO goodput scorer). docs/SERVING.md has the architecture tour.
 """
@@ -35,6 +39,11 @@ from fleetx_tpu.serving.cache_manager import (
     TieredPageStore,
     scatter_slot,
 )
+from fleetx_tpu.serving.embedding_engine import (
+    EmbeddingEngine,
+    decode_floats,
+    encode_floats,
+)
 from fleetx_tpu.serving.engine import (
     QueueFull,
     RecoveryExhausted,
@@ -44,7 +53,16 @@ from fleetx_tpu.serving.engine import (
     TickTimeout,
     sample_tokens,
 )
+from fleetx_tpu.serving.ernie_engine import ErnieScoringEngine
+from fleetx_tpu.serving.batch_engine import BatchingEngine
 from fleetx_tpu.serving.metrics import ServingMetrics
+from fleetx_tpu.serving.model_protocol import (
+    ENGINE_SURFACE,
+    GPTExecutor,
+    ModelCapabilities,
+    ModelExecutor,
+    engine_conforms,
+)
 from fleetx_tpu.serving.router import (
     ReplicaState,
     RouterMetrics,
@@ -74,6 +92,16 @@ __all__ = [
     "ServingResult",
     "ShuttingDown",
     "TickTimeout",
+    "BatchingEngine",
+    "EmbeddingEngine",
+    "ErnieScoringEngine",
+    "ENGINE_SURFACE",
+    "GPTExecutor",
+    "ModelCapabilities",
+    "ModelExecutor",
+    "engine_conforms",
+    "decode_floats",
+    "encode_floats",
     "DiskPageStore",
     "HostPageStore",
     "PagePool",

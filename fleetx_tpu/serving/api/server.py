@@ -9,8 +9,21 @@ stock OpenAI client or curl can talk to (docs/SERVING.md
   closed by ``data: [DONE]``), ``stream: false`` aggregates.
 - ``POST /v1/completions`` — classic text-completion shape, same
   streaming contract (``text_completion`` chunks).
-- ``GET /v1/models`` — the one served model id.
+- ``POST /v1/embeddings`` — fronts a KV-free embedding family on a
+  heterogeneous fleet (float vectors in, float vectors out; 404 when
+  no such family is served).
+- ``GET /v1/models`` — the served model listing. Plain-engine targets
+  report the one configured ``model_id``; a model-aware router derives
+  the list from its replica groups (every family + the ``model_id``
+  alias for the default group), with replica indices and capability
+  flags as extension fields.
 - ``GET /healthz`` — engine ``health()`` dict, or the router aggregate.
+
+On a heterogeneous fleet the ``model`` field of a completion request
+may name any served family (docs/SERVING.md "Heterogeneous fleet");
+it rides ``submit(model=...)`` so dispatch stays group-local. The
+configured ``model_id`` keeps addressing the default group, so stock
+single-model clients never change.
 
 ``target`` is anything with the ``submit / step / take_result /
 cancel`` surface — a :class:`~fleetx_tpu.serving.engine.ServingEngine`,
@@ -168,7 +181,8 @@ class _ApiHandler(JsonHandler):
         path = self.path.split("?", 1)[0].rstrip("/")
         api = self._api()
         chat = path == "/v1/chat/completions"
-        if not chat and path != "/v1/completions":
+        embeddings = path == "/v1/embeddings"
+        if not chat and not embeddings and path != "/v1/completions":
             self._send_json(404, ApiError(
                 404, f"unknown path {self.path!r}").body())
             return
@@ -176,7 +190,10 @@ class _ApiHandler(JsonHandler):
             body = self._read_json()
             if not isinstance(body, dict):
                 raise ApiError(400, "request body must be a JSON object")
-            api.handle_completion(self, body, chat=chat)
+            if embeddings:
+                api.handle_embeddings(self, body)
+            else:
+                api.handle_completion(self, body, chat=chat)
         except ApiError as e:
             api.metrics.errors.labels(code=str(e.code)).inc()
             self._send_json(e.code, e.body())
@@ -262,11 +279,34 @@ class ApiServer(HttpDaemon):
 
     # ------------------------------------------------------------ routes
 
+    def _served_models(self) -> Dict[str, Dict]:
+        """The router's per-family replica-group view, ``{}`` for plain
+        engine targets (which serve exactly the configured model id)."""
+        if hasattr(self.target, "models"):
+            with self._lock:
+                return self.target.models()
+        return {}
+
     def models_payload(self) -> Dict:
-        """The ``/v1/models`` listing (one served model)."""
-        return {"object": "list",
-                "data": [{"id": self.model_id, "object": "model",
-                          "created": self._created, "owned_by": "fleetx"}]}
+        """The ``/v1/models`` listing: derived from the router's replica
+        groups when the target has them (one entry per family, plus the
+        configured ``model_id`` as an alias of the default group), else
+        the single configured model."""
+        served = self._served_models()
+        data = [{"id": self.model_id, "object": "model",
+                 "created": self._created, "owned_by": "fleetx"}]
+        if served:
+            default = getattr(self.target, "_default_model", None)
+            data[0]["group"] = default
+            for family in sorted(served):
+                info = served[family]
+                data.append({"id": family, "object": "model",
+                             "created": self._created,
+                             "owned_by": "fleetx",
+                             "replicas": info["replicas"],
+                             "live": info["live"],
+                             "capabilities": info["capabilities"]})
+        return {"object": "list", "data": data}
 
     def health(self) -> Dict:
         """The ``/healthz`` body: the engine's ``health()`` dict, or a
@@ -292,9 +332,18 @@ class ApiServer(HttpDaemon):
         BEFORE the engine is touched — the engine never sees a request
         the validator wouldn't vouch for."""
         model = body.get("model")
+        model_kw: Dict = {}
         if model is not None and model != self.model_id:
-            raise ApiError(404, f"model {model!r} not found (serving "
-                                f"{self.model_id!r})", "model_not_found")
+            served = self._served_models()
+            if model in served:
+                # family-addressed request on a heterogeneous fleet:
+                # dispatch stays inside this model group
+                model_kw["model"] = model
+            else:
+                raise ApiError(
+                    404, f"model {model!r} not found (serving "
+                    f"{sorted(served) or [self.model_id]})",
+                    "model_not_found")
         if body.get("n", 1) != 1:
             raise ApiError(400, "n > 1 is not supported")
         if chat:
@@ -314,7 +363,7 @@ class ApiServer(HttpDaemon):
         if not ids:
             raise ApiError(400, "prompt is empty after encoding")
 
-        kw: Dict = {}
+        kw: Dict = dict(model_kw)
         max_tokens = body.get("max_tokens", body.get(
             "max_completion_tokens"))
         if max_tokens is not None:
@@ -397,6 +446,86 @@ class ApiServer(HttpDaemon):
         finally:
             with self._inflight_lock:
                 self._inflight -= 1
+            self.metrics.active.inc(-1)
+
+    def handle_embeddings(self, handler: _ApiHandler, body: Dict) -> None:
+        """One ``/v1/embeddings`` request: float vectors in, float
+        vectors out, through a KV-free embedding family's int32 wire
+        encoding (serving/embedding_engine.py). 404 when the fleet
+        serves no such family; when it serves several, the request must
+        name one."""
+        from fleetx_tpu.serving.embedding_engine import (decode_floats,
+                                                         encode_floats)
+
+        served = self._served_models()
+        float_out = sorted(
+            fam for fam, info in served.items()
+            if info["capabilities"]
+            and info["capabilities"].get("emits") == "floats")
+        model = body.get("model")
+        if model is None:
+            if len(float_out) != 1:
+                raise ApiError(
+                    404 if not float_out else 400,
+                    f"no unambiguous embedding model served (float-out "
+                    f"families: {float_out}); name one", "model_not_found")
+            model = float_out[0]
+        elif model not in float_out:
+            raise ApiError(404, f"model {model!r} is not a served "
+                                f"embedding family (have {float_out})",
+                           "model_not_found")
+        inp = body.get("input")
+        if isinstance(inp, list) and inp and all(
+                isinstance(v, (int, float)) and not isinstance(v, bool)
+                for v in inp):
+            rows = [inp]
+        elif isinstance(inp, list) and inp and all(
+                isinstance(r, list) and r and all(
+                    isinstance(v, (int, float)) and not isinstance(v, bool)
+                    for v in r) for r in inp):
+            rows = inp
+        else:
+            raise ApiError(
+                400, "input must be a non-empty array of numbers (one "
+                "flattened image/vector) or an array of such arrays")
+        self.metrics.requests.labels(route="embeddings").inc()
+        with self._inflight_lock:
+            self._inflight += len(rows)
+        self.metrics.active.inc()
+        t0 = time.monotonic()
+        try:
+            data = []
+            pending = []
+            for row in rows:
+                q: "queue.Queue" = queue.Queue()
+
+                def sink(_rid, tok, finished, _q=q):
+                    _q.put((int(tok), bool(finished)))
+
+                ids = [int(t) for t in encode_floats(row)]
+                pending.append(
+                    (q, self._submit(ids, dict(model=model), sink)))
+            for index, (q, rid) in enumerate(pending):
+                result = self._await_result(q, rid, t0, lambda _t: None)
+                if result.finish_reason != "complete":
+                    raise ApiError(
+                        503 if result.finish_reason in ("shutdown",
+                                                        "timeout")
+                        else 500,
+                        f"embedding request ended {result.finish_reason!r}",
+                        "server_error")
+                data.append({
+                    "object": "embedding", "index": index,
+                    "embedding": [float(v) for v in
+                                  decode_floats(result.tokens)]})
+            n_in = sum(len(r) for r in rows)
+            handler._send_json(200, {
+                "object": "list", "data": data, "model": model,
+                "usage": {"prompt_tokens": n_in,
+                          "total_tokens": n_in}})
+        finally:
+            with self._inflight_lock:
+                self._inflight -= len(rows)
             self.metrics.active.inc(-1)
 
     def _await_result(self, q: "queue.Queue", rid: int, t0: float,

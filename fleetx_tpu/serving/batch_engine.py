@@ -1,0 +1,457 @@
+"""KV-free dynamic-batching serving engine base (the simplest engine).
+
+``ServingEngine`` earns its complexity from the KV cache: slots, pages,
+spill tiers, replay recovery all exist because autoregressive decode
+carries device state between ticks. Encoder-style models carry NONE —
+an ERNIE fill-in-blank scoring call or a ViT embedding is one batched
+forward — so their engine is pure request coalescing: admit up to
+``slots`` queued requests per tick, bucket them into padded batches,
+run one jitted forward per bucket, emit every output, retire. No cache
+pool, no slot lifecycle beyond the duration of a single ``step()``.
+
+What it KEEPS from the big engine is the operational contract
+(serving/model_protocol.py ``ENGINE_SURFACE``), so routers, the API
+layer, and the chaos tooling apply unmodified:
+
+- **Admission**: bounded queue (``FLEETX_SERVING_MAX_QUEUE`` →
+  :class:`QueueFull`), drain rejects (:class:`ShuttingDown`),
+  queue-TTL and total-deadline shedding to ``finish_reason="timeout"``.
+- **Exactly one terminal result** per submit: ``complete`` on success
+  (the encoder analogue of ``eos`` — there is nothing to decode
+  further), ``timeout`` / ``cancelled`` / ``error`` / ``shutdown``
+  exactly as the big engine defines them.
+- **Fault discipline**: the forward runs under the same
+  ``faults.on_serving_tick`` seam; a raising call requeues the batch at
+  the head (arrival order preserved — outputs were never emitted, so
+  the retry is trivially byte-identical), strikes the requests, and
+  after ``max_recoveries`` consecutive strikes retires them as
+  ``error`` instead of spinning (``tick_fault`` / ``engine_recovery``
+  events banked, same names the chaos assertions grep for).
+- **Observability**: the standard ``fleetx_serving_*`` families via
+  ``ServingMetrics``, plus the dynamic-batching pair
+  (``fleetx_serving_batched_forwards_total``,
+  ``fleetx_serving_batch_occupancy``) — docs/OBSERVABILITY.md.
+- **Migration**: deterministic forwards make failover trivial — a
+  request re-submitted with ``history=`` (the router's durable copy)
+  re-runs and emits only the tokens past the history, byte-identical.
+
+Output tokens are the WIRE ENCODING of the model's answer: token ids
+for fill-in-blank, a class id for classification, or a float32 vector
+bit-cast to int32 for embeddings (lossless; ``decode_floats`` in
+serving/embedding_engine.py inverts it). Riding the int32 token channel
+end to end is what lets every router/recovery/chaos invariant — built
+for token streams — hold for non-token models without modification.
+
+Concrete engines: ``ErnieScoringEngine`` (serving/ernie_engine.py) and
+``EmbeddingEngine`` (serving/embedding_engine.py). docs/SERVING.md
+"Heterogeneous fleet" has the architecture.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from fleetx_tpu.obs.events import emit as obs_emit
+from fleetx_tpu.obs.tracing import span
+from fleetx_tpu.resilience.faults import faults
+from fleetx_tpu.serving.engine import (
+    QueueFull,
+    ServingResult,
+    ShuttingDown,
+    _env_float,
+    _env_int,
+)
+from fleetx_tpu.serving.metrics import ServingMetrics
+from fleetx_tpu.serving.model_protocol import ModelCapabilities
+from fleetx_tpu.serving.scheduler import FIFOScheduler, Request
+from fleetx_tpu.utils.log import logger
+
+__all__ = ["BatchingEngine"]
+
+
+def _bucket(n: int, cap: int) -> int:
+    """Next power of two >= n, capped — bounds distinct jit shapes."""
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class BatchingEngine:
+    """Dynamic-batching engine over one encoder-style model (module
+    docstring). Subclasses set ``capabilities`` / ``cache_len`` and
+    implement ``_validate(prompt)`` + ``_run_batch(requests)``."""
+
+    #: subclasses override (ModelCapabilities of the served family)
+    capabilities: ModelCapabilities
+
+    def __init__(self, model, variables, *, slots: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 queue_ttl_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None,
+                 grace_s: Optional[float] = None,
+                 max_recoveries: Optional[int] = None,
+                 base_seed: int = 0,
+                 metrics: Optional[ServingMetrics] = None):
+        self.model = model
+        self.params = (variables["params"] if isinstance(variables, dict)
+                       and "params" in variables else variables)
+        self.slots = slots or _env_int("FLEETX_SERVING_SLOTS", 8)
+        self.max_queue = (max_queue if max_queue is not None
+                          else _env_int("FLEETX_SERVING_MAX_QUEUE", 0))
+        self.queue_ttl_s = (queue_ttl_s if queue_ttl_s is not None
+                            else _env_float("FLEETX_SERVING_QUEUE_TTL_S",
+                                            0.0))
+        self.deadline_s = (deadline_s if deadline_s is not None
+                           else _env_float("FLEETX_SERVING_DEADLINE_S", 0.0))
+        self.grace_s = (grace_s if grace_s is not None
+                        else _env_float("FLEETX_SERVING_GRACE_S", 30.0))
+        self.max_recoveries = max(1, max_recoveries if max_recoveries
+                                  is not None
+                                  else _env_int(
+                                      "FLEETX_SERVING_MAX_RECOVERIES", 8))
+        # router-facing shape attrs (ENGINE_SURFACE): a KV-free engine is
+        # never paged, never phase-split, and its "cache length" is just
+        # its per-request input bound
+        self.role = "both"
+        self.paged = False
+        self.page_size = 0
+        self.model_family = self.capabilities.family
+        self.cache_len = self.capabilities.max_input
+        self.scheduler = FIFOScheduler()
+        self.metrics = metrics or ServingMetrics(self.slots)
+        self._base_key = jax.random.PRNGKey(base_seed)
+        self._results: Dict[int, ServingResult] = {}
+        self._strikes: Dict[int, int] = {}
+        self._next_id = 0
+        self._ticks = 0
+        self._fault_ticks = 0
+        self._recovery_streak = 0
+        self._shutting_down = False
+        self._shutdown_deadline: Optional[float] = None
+        self._dead = False
+        self._now = time.perf_counter  # swappable clock (chaos tests)
+
+    # ---------------------------------------------------- subclass hooks
+
+    def _validate(self, prompt: np.ndarray) -> None:
+        """Raise ValueError when ``prompt`` is not servable here — the
+        heterogeneous-rejection seam the router turns into try-the-
+        others / clean error."""
+        raise NotImplementedError
+
+    def _run_batch(self, requests: List[Request]) -> List[List[int]]:
+        """One coalesced device call: the wire-encoded output token list
+        for each request, in order. Runs under the fault seam — raise
+        freely; the base requeues and retries."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, prompt, *, on_token=None, seed: Optional[int] = None,
+               rng_key: Optional[jax.Array] = None,
+               queue_ttl_s: Optional[float] = None,
+               deadline_s: Optional[float] = None,
+               history=None, kv_payloads=None,
+               max_length: Optional[int] = None,
+               min_length: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               decode_strategy: Optional[str] = None,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None) -> int:
+        """Queue one request; returns its id. The signature is the
+        ENGINE_SURFACE submit: sampling knobs are accepted (a router
+        forwards whatever the caller set) and IGNORED — every forward
+        here is deterministic, so there is no stream to steer. A
+        non-None ``kv_payloads`` is a placement bug and rejects with
+        ValueError (no KV cache to revive into); ``history`` replays a
+        migrated request (the deterministic forward re-derives the same
+        outputs and ``on_token`` fires only past the history)."""
+        del max_length, min_length, eos_token_id, decode_strategy
+        del temperature, top_k, top_p  # deterministic encoder: no knobs
+        if self._shutting_down:
+            self.metrics.record_drain_reject()
+            obs_emit("drain_reject", engine=self.metrics.engine_label)
+            raise ShuttingDown(
+                "engine is draining toward shutdown; submit to another "
+                "replica")
+        if self.max_queue and self.scheduler.queue_depth >= self.max_queue:
+            self._expire_queued(self._now())
+        if self.max_queue and self.scheduler.queue_depth >= self.max_queue:
+            self.metrics.record_reject()
+            obs_emit("queue_reject", engine=self.metrics.engine_label,
+                     queue_depth=self.scheduler.queue_depth)
+            raise QueueFull(
+                f"admission queue is full ({self.scheduler.queue_depth}/"
+                f"{self.max_queue} waiting); retry later or raise "
+                "FLEETX_SERVING_MAX_QUEUE")
+        if kv_payloads is not None:
+            raise ValueError(
+                f"model family {self.model_family!r} has no KV cache to "
+                "revive shipped pages into (capabilities.has_kv_cache="
+                "False) — this engine cannot take a disaggregated handoff")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        self._validate(prompt)
+        rid = self._next_id
+        self._next_id += 1
+        if rng_key is None:
+            rng_key = (jax.random.PRNGKey(int(seed)) if seed is not None
+                       else jax.random.fold_in(self._base_key, rid))
+        req = Request(
+            id=rid, prompt=prompt, max_new_tokens=1, min_new_tokens=0,
+            eos_token_id=-1, greedy=True, temperature=1.0, top_k=0,
+            top_p=1.0, rng_key=rng_key, on_token=on_token,
+            submit_time=self._now(),
+            queue_ttl_s=float(queue_ttl_s if queue_ttl_s is not None
+                              else self.queue_ttl_s),
+            deadline_s=float(deadline_s if deadline_s is not None
+                             else self.deadline_s),
+        )
+        if history is not None:
+            # migrated replay: the router's durable copy of what the
+            # caller already saw; the deterministic forward re-derives
+            # the full output and emission skips this prefix
+            req.tokens.extend(int(t) for t in
+                              np.asarray(history, np.int64).reshape(-1))
+        self.scheduler.submit(req)
+        self.metrics.record_submit()
+        return rid
+
+    # -------------------------------------------------------------- step
+
+    def step(self) -> Dict:
+        """One tick: shed expired queued work, coalesce up to ``slots``
+        requests into one batched forward (the fault seam wraps it),
+        emit outputs, retire. Returns a summary dict shaped like the big
+        engine's (``retired``/``timed_out``/``queue_depth``/...)."""
+        t0 = self._now()
+        self._ticks += 1
+        timed_out = self._expire_queued(t0)
+        retired: List[int] = []
+        recovered = False
+        if (self._shutting_down and self._shutdown_deadline is not None
+                and t0 > self._shutdown_deadline):
+            retired.extend(self._retire_all("shutdown"))
+        batch: List[Request] = []
+        while len(batch) < self.slots:
+            req = self.scheduler.pop_next()
+            if req is None:
+                break
+            batch.append(req)
+        forwards = 0
+        if batch:
+            attempt = self._fault_ticks
+            self._fault_ticks += 1
+            try:
+                with span("serving.batch_forward", engine_tick=self._ticks,
+                          batch=len(batch)):
+                    faults.on_serving_tick(attempt)
+                    outputs = self._run_batch(batch)
+            except Exception as e:  # noqa: BLE001 — requeue-and-retry seam
+                recovered = True
+                retired.extend(self._on_batch_fault(batch, e))
+            else:
+                forwards = 1
+                self._recovery_streak = 0
+                self.metrics.record_batched_forward(len(batch), self.slots)
+                now = self._now()
+                for req, out in zip(batch, outputs):
+                    self._strikes.pop(req.id, None)
+                    self._emit_and_finalize(req, out, now)
+                    retired.append(req.id)
+        self.metrics.observe_tick(self.scheduler.queue_depth, 0,
+                                  self._now() - t0)
+        return {"admitted": len(batch), "retired": retired,
+                "timed_out": timed_out, "forwards": forwards,
+                "recovered": recovered,
+                "queue_depth": self.scheduler.queue_depth}
+
+    def _emit_and_finalize(self, req: Request, out: List[int],
+                           now: float) -> None:
+        """Deliver one request's outputs and record its terminal
+        result. History tokens (migrated replay) are skipped on the
+        callback — the caller already has them — but ride the result."""
+        already = len(req.tokens)
+        out = [int(t) for t in out]
+        req.tokens = out
+        req.admit_time = now
+        self.metrics.record_admit(now - req.submit_time)
+        cb_error = False
+        for i, tok in enumerate(out[already:]):
+            if req.first_token_time is None:
+                req.first_token_time = self._now()
+                self.metrics.record_first_token(
+                    req.first_token_time - req.submit_time)
+            if req.on_token is not None and not cb_error:
+                try:
+                    req.on_token(req.id, tok,
+                                 already + i + 1 == len(out))
+                except Exception:  # noqa: BLE001 — caller bug, not ours
+                    cb_error = True
+                    logger.exception(
+                        "serving: on_token callback raised for request "
+                        "%d; delivery stops, result still records", req.id)
+        self.metrics.record_tokens(len(out) - already)
+        self._finalize(req, "error" if cb_error else "complete", self._now())
+
+    def _on_batch_fault(self, batch: List[Request], err: Exception
+                        ) -> List[int]:
+        """The KV-free recovery path: nothing was emitted, so retry is
+        requeue-at-head in arrival order; requests that keep striking
+        retire as ``error`` (the poison analogue), and the engine
+        declares itself dead past ``max_recoveries`` consecutive
+        faulted ticks."""
+        obs_emit("tick_fault", engine=self.metrics.engine_label,
+                 error=f"{type(err).__name__}: {err}", batch=len(batch))
+        logger.warning(
+            "serving: batched forward over %d request(s) raised (%s); "
+            "requeueing at head", len(batch), err)
+        now = self._now()
+        dead = []
+        for req in reversed(batch):
+            self._strikes[req.id] = self._strikes.get(req.id, 0) + 1
+            if self._strikes[req.id] > self.max_recoveries:
+                self._strikes.pop(req.id, None)
+                self._finalize(req, "error", now)
+                dead.append(req.id)
+            else:
+                self.scheduler.requeue(req)
+        self.metrics.record_recovery()
+        self._recovery_streak += 1
+        obs_emit("engine_recovery", engine=self.metrics.engine_label,
+                 streak=self._recovery_streak)
+        if self._recovery_streak > self.max_recoveries:
+            self._dead = True
+        return dead
+
+    def _expire_queued(self, now: float) -> List[int]:
+        expired = self.scheduler.pop_expired(now)
+        out = []
+        for req in expired:
+            self._finalize(req, "timeout", now)
+            obs_emit("request_timeout", request=req.id, where="queue")
+            out.append(req.id)
+        return out
+
+    def _finalize(self, req: Request, reason: str, now: float) -> None:
+        if req.id in self._results:
+            return  # exactly-one-result: first terminal reason wins
+        req.phase = "finished"
+        self._results[req.id] = ServingResult(
+            id=req.id, prompt=req.prompt,
+            tokens=np.asarray(req.tokens, np.int32),
+            finish_reason=reason,
+            ttft_s=(req.first_token_time or now) - req.submit_time,
+            latency_s=now - req.submit_time,
+        )
+        self.metrics.record_retire(now - req.submit_time, reason)
+
+    # ------------------------------------------------- results/lifecycle
+
+    def result(self, request_id: int) -> Optional[ServingResult]:
+        """Finished result for ``request_id`` (None while in flight)."""
+        return self._results.get(request_id)
+
+    def take_result(self, request_id: int) -> Optional[ServingResult]:
+        """Remove and return one finished result (None while queued)."""
+        return self._results.pop(request_id, None)
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a queued request: exactly one terminal result with
+        ``finish_reason="cancelled"``. False when unknown/finished
+        (requests are only ever in-flight INSIDE one step() call, so
+        between ticks everything unfinished is queued)."""
+        req = self.scheduler.remove(request_id)
+        if req is None:
+            return False
+        self._finalize(req, "cancelled", self._now())
+        obs_emit("request_cancelled", request=request_id,
+                 engine=self.metrics.engine_label)
+        return True
+
+    def emitted_tokens(self, request_id: int) -> Optional[list]:
+        """Host-truth tokens of a live request (its migrated-history
+        prefix; a KV-free engine emits everything else atomically at
+        retirement). None for unknown/finished ids."""
+        for r in self.scheduler.snapshot():
+            if r.id == request_id:
+                return list(r.tokens)
+        return None
+
+    def request_shutdown(self, grace_s: Optional[float] = None) -> None:
+        """Flip into draining mode: submits reject, queued work finishes
+        until the grace deadline, leftovers retire as ``shutdown``."""
+        if self._shutting_down:
+            return
+        self._shutting_down = True
+        grace = self.grace_s if grace_s is None else float(grace_s)
+        self._shutdown_deadline = self._now() + max(grace, 0.0)
+        obs_emit("shutdown", engine=self.metrics.engine_label,
+                 active=0, queued=self.scheduler.queue_depth)
+
+    def shutdown(self, grace_s: Optional[float] = None
+                 ) -> Dict[int, ServingResult]:
+        """Graceful drain to completion; every submitted request gets a
+        terminal result."""
+        self.request_shutdown(grace_s)
+        while len(self.scheduler):
+            self.step()
+        out, self._results = self._results, {}
+        return out
+
+    def drain(self, max_ticks: Optional[int] = None
+              ) -> Dict[int, ServingResult]:
+        """Tick until the queue is empty (or ``max_ticks``), then
+        return-and-clear every finished result."""
+        n = 0
+        while len(self.scheduler):
+            self.step()
+            n += 1
+            if max_ticks is not None and n >= max_ticks:
+                break
+        out, self._results = self._results, {}
+        return out
+
+    def _retire_all(self, reason: str) -> List[int]:
+        now = self._now()
+        out = []
+        for req in self.scheduler.drain_all():
+            self._finalize(req, reason, now)
+            out.append(req.id)
+        return out
+
+    def declare_dead(self) -> None:
+        """Mark the engine dead without shutdown machinery (the
+        supervisor/router seam — see ServingEngine.declare_dead)."""
+        self._dead = True
+
+    # ------------------------------------------------------ health/shape
+
+    def health(self) -> Dict:
+        """The ``/healthz`` JSON body (ENGINE_SURFACE): drain-aware
+        state plus the model family + capability flags the model-aware
+        router groups replicas by."""
+        state = ("dead" if self._dead
+                 else "draining" if self._shutting_down else "ok")
+        return {"state": state,
+                "role": self.role,
+                "model": self.model_family,
+                "capabilities": self.capabilities.as_dict(),
+                "queue_depth": self.scheduler.queue_depth,
+                "queue_tokens": self.scheduler.queued_tokens(),
+                "active": 0,
+                "slots": self.slots}
+
+    @property
+    def submit_limit(self) -> int:
+        """Smallest rejected per-request input size (router admission
+        bound): a KV-free request needs no decode room, so the bound is
+        one past the model's input capacity."""
+        return self.cache_len + 1

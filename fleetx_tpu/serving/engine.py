@@ -238,9 +238,8 @@ from fleetx_tpu.obs.tracing import span
 from fleetx_tpu.models.gpt.generation import (
     GenerationConfig,
     _top_p_cutoff_bisect,
-    decode_step,
-    init_decode_cache,
 )
+from fleetx_tpu.serving.model_protocol import GPTExecutor
 from fleetx_tpu.serving.cache_manager import (
     DiskPageStore,
     HostPageStore,
@@ -402,8 +401,26 @@ class ServingEngine:
                  role: Optional[str] = None,
                  disk_cache_dir: Optional[str] = None,
                  disk_cache_bytes: Optional[int] = None,
-                 mesh=None):
+                 mesh=None, executor=None):
         gen_cfg = gen_cfg or GenerationConfig(decode_strategy="greedy")
+        # the model-side serving contract (serving/model_protocol.py):
+        # every model compute call below goes through the executor, and
+        # the capability flags gate which engine features are legal —
+        # the GPT executor is pure delegation to the pre-extraction
+        # functions, so this engine's behavior is byte-identical. A
+        # default executor is built LATER, over the decode-configured
+        # model clone (cache length/pages ride cfg) — here only the
+        # capability gates run.
+        self.executor = executor
+        self.capabilities = (executor.capabilities if executor is not None
+                             else GPTExecutor(model).capabilities)
+        self.model_family = self.capabilities.family
+        if not self.capabilities.has_kv_cache:
+            raise ValueError(
+                f"model family {self.model_family!r} has no KV cache "
+                "(capabilities.has_kv_cache=False); serve it behind a "
+                "KV-free engine (serving/batch_engine.py), not "
+                "ServingEngine")
         if gen_cfg.repetition_penalty != 1.0:
             raise ValueError("continuous batching does not support "
                              "repetition_penalty (use one-shot generate())")
@@ -510,6 +527,14 @@ class ServingEngine:
                 model.cfg, decode_cache_len=cache_len,
                 decode_num_pages=None, decode_page_size=None,
                 decode_kv_dtype=decode_kv))
+        if self.executor is None:
+            # wrap the decode-configured clone: init_cache/forward read
+            # decode_cache_len/pages off cfg, so the executor must see
+            # the same model object every pre-extraction call site saw
+            self.executor = GPTExecutor(self.model,
+                                        family=self.model_family)
+        elif hasattr(self.executor, "bind"):
+            self.executor = self.executor.bind(self.model)
         self.params = (variables["params"]
                        if isinstance(variables, dict) and "params" in variables
                        else variables)
@@ -669,6 +694,10 @@ class ServingEngine:
         self.spec_k = (spec_k if spec_k is not None
                        else _env_int("FLEETX_SERVING_SPEC_K", 4))
         self._proposer = None
+        if self.spec and not self.capabilities.supports_spec:
+            raise ValueError(
+                f"model family {self.model_family!r} does not support "
+                "speculative decoding (capabilities.supports_spec=False)")
         if self.spec:
             if self.spec_k < 1:
                 raise ValueError(
@@ -1663,6 +1692,12 @@ class ServingEngine:
                  else "draining" if self._shutting_down else "ok")
         out = {"state": state,
                "role": self.role,
+               # model-aware routing (docs/SERVING.md "Heterogeneous
+               # fleet"): the served family + capability flags ride the
+               # same report, so a router groups replicas per model from
+               # the scrape it already performs
+               "model": self.model_family,
+               "capabilities": self.capabilities.as_dict(),
                "queue_depth": self.scheduler.queue_depth,
                # prefill load prices in TOKENS (prefill cost scales with
                # prompt length, not request count): queued prompts plus
@@ -1685,6 +1720,14 @@ class ServingEngine:
         this engine is gone (e.g. the replica-kill chaos path). Ticking a
         declared-dead engine is the caller's bug, not prevented here."""
         self._dead = True
+
+    @property
+    def submit_limit(self) -> int:
+        """The smallest REJECTED per-request prompt size (the engine
+        needs at least one token of decode room below it) — the
+        per-model admission bound the router validates against at its
+        own submit (serving/model_protocol.py ENGINE_SURFACE)."""
+        return min(self.cache_len, self.model.cfg.max_position_embeddings)
 
     # ------------------------------------------------------------- internals
 
@@ -1899,8 +1942,8 @@ class ServingEngine:
             # live window until decode overwrites them one by one
             pos = jnp.minimum(jnp.arange(bucket_len, dtype=jnp.int32),
                               max_pos - 1)[None, :]
-            logits, small = decode_step(
-                self.model, params, init_decode_cache(self.model, 1), ids, pos)
+            logits, small = self.executor.forward(
+                params, self.executor.init_cache(1), ids, pos)
             cache = self._pin_cache(scatter_slot(cache, small, slot))
             last = jax.lax.dynamic_slice_in_dim(
                 logits[0], true_len - 1, 1, axis=0).astype(jnp.float32)
@@ -1908,7 +1951,7 @@ class ServingEngine:
             last = jnp.where(
                 (jnp.arange(vocab)[None, :] == eos) & (min_new > 0),
                 _NEG, last)
-            tok = sample_tokens(
+            tok = self.executor.sample(
                 last, key[None], greedy[None], temperature[None],
                 top_k[None], top_p[None], topk_cap=self.topk_cap)[0]
             return cache, tok
@@ -1934,8 +1977,8 @@ class ServingEngine:
             # the live window (or on the trash page) — cache_manager.py
             pos = jnp.minimum(wpos + jnp.arange(bucket_len, dtype=jnp.int32),
                               max_pos - 1)[None, :]
-            logits, cache = decode_step(
-                self.model, params, cache, ids, pos,
+            logits, cache = self.executor.forward(
+                params, cache, ids, pos,
                 cache_positions=wpos[None], block_tables=table[None])
             cache = self._pin_cache(cache)
             last = jax.lax.dynamic_slice_in_dim(
@@ -1944,7 +1987,7 @@ class ServingEngine:
             last = jnp.where(
                 (jnp.arange(vocab)[None, :] == eos) & (min_new > 0),
                 _NEG, last)
-            tok = sample_tokens(
+            tok = self.executor.sample(
                 last, key[None], greedy[None], temperature[None],
                 top_k[None], top_p[None], topk_cap=self.topk_cap)[0]
             return cache, tok
@@ -2066,8 +2109,8 @@ class ServingEngine:
             # bucket tail
             pos = jnp.minimum(wpos + jnp.arange(bucket_len, dtype=jnp.int32),
                               max_pos - 1)[None, :]
-            logits, cache = decode_step(
-                self.model, params, cache, ids, pos,
+            logits, cache = self.executor.forward(
+                params, cache, ids, pos,
                 cache_positions=wpos[None])
             cache = self._pin_cache(cache)
             last = jax.lax.dynamic_slice_in_dim(
@@ -2076,7 +2119,7 @@ class ServingEngine:
             last = jnp.where(
                 (jnp.arange(vocab)[None, :] == eos) & (min_new > 0),
                 _NEG, last)
-            tok = sample_tokens(
+            tok = self.executor.sample(
                 last, key[None], greedy[None], temperature[None],
                 top_k[None], top_p[None], topk_cap=self.topk_cap)[0]
             return cache, tok
@@ -2191,7 +2234,7 @@ class ServingEngine:
                 req.phase = "prefilling"
                 if not self.paged:
                     req.chunk_cache = self._shard_cache(
-                        init_decode_cache(self.model, 1))
+                        self.executor.init_cache(1))
                 self._prefilling[req.slot] = req
                 req.admit_time = self._now()
                 self.metrics.record_admit(req.admit_time - req.submit_time)
@@ -2379,8 +2422,8 @@ class ServingEngine:
         max_pos = self.model.cfg.max_position_embeddings
         wpos = jnp.where(active, lengths, self.cache_len - 1)
         posid = jnp.where(active, jnp.minimum(lengths, max_pos - 1), 0)
-        logits, cache = decode_step(
-            self.model, params, cache, st["last_tok"][:, None],
+        logits, cache = self.executor.forward(
+            params, cache, st["last_tok"][:, None],
             posid[:, None], None, cache_positions=wpos,
             block_tables=tables)
         step = logits[:, -1, :].astype(jnp.float32)
@@ -2394,9 +2437,9 @@ class ServingEngine:
         else:
             keys = jax.vmap(functools.partial(jax.random.split, num=2))(
                 st["rng"])
-            tok = sample_tokens(step, keys[:, 0], st["greedy"],
-                                st["temperature"], st["top_k"], st["top_p"],
-                                topk_cap=self.topk_cap)
+            tok = self.executor.sample(step, keys[:, 0], st["greedy"],
+                                       st["temperature"], st["top_k"],
+                                       st["top_p"], topk_cap=self.topk_cap)
             new_rng = jnp.where(active[:, None], keys[:, 1], st["rng"])
         new_len = lengths + 1
         decoded = st["decoded"] + 1
@@ -2528,8 +2571,8 @@ class ServingEngine:
         posid = jnp.minimum(wpos[:, None] + jnp.arange(s, dtype=jnp.int32),
                             max_pos - 1)
         posid = jnp.where(active[:, None], posid, 0)
-        logits, cache = decode_step(
-            self.model, params, cache, ids, posid, None,
+        logits, cache = self.executor.forward(
+            params, cache, ids, posid, None,
             cache_positions=wpos, block_tables=tables)
         logits = logits.astype(jnp.float32)
         vocab = logits.shape[-1]
@@ -2564,7 +2607,7 @@ class ServingEngine:
             # per-row sampler filter pipeline (rows repeated per
             # position: row b*s + j filters position j of lane b)
             b = logits.shape[0]
-            filt = filter_logits(
+            filt = self.executor.filter(
                 logits.reshape(b * s, vocab),
                 jnp.repeat(st["temperature"], s),
                 jnp.repeat(st["top_k"], s),

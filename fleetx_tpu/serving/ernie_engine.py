@@ -1,0 +1,107 @@
+"""ERNIE fill-in-blank / scoring engine (encoder-style serving).
+
+``ErnieForPretraining`` is a bidirectional encoder: one forward over
+the whole sequence yields MLM logits at chosen positions plus a
+sentence-order (SOP) head — there is no autoregressive loop, so the
+engine is a :class:`~fleetx_tpu.serving.batch_engine.BatchingEngine`
+over dynamic padded batches. Two request shapes ride the same submit:
+
+- **Fill-in-blank**: a prompt containing mask tokens
+  (``FLEETX_ERNIE_MASK_ID``, default 3 — ERNIE-1.0's ``[MASK]``). The
+  engine finds the mask positions, runs the batched forward with a
+  fixed-size ``masked_positions`` gather (padded to
+  ``FLEETX_ERNIE_MAX_MASKS`` so every batch traces the same program),
+  and emits the argmax token id per blank, in prompt order.
+- **Scoring**: a prompt with NO masks. The output is one token — the
+  SOP head's argmax (0 = coherent ordering, 1 = swapped) — the
+  cheapest useful whole-sequence judgment the pretraining heads give.
+
+Batches are bucketed on (batch→pow2, padded-length→pow2) like the GPT
+prefill path, so distinct jit traces stay logarithmic in both axes.
+Padding rows use ``pad_token_id`` with an explicit attention mask, so
+padded and unpadded runs agree. docs/SERVING.md "Heterogeneous fleet".
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from fleetx_tpu.serving.batch_engine import BatchingEngine, _bucket
+from fleetx_tpu.serving.engine import _env_int
+from fleetx_tpu.serving.model_protocol import ModelCapabilities
+
+__all__ = ["ErnieScoringEngine"]
+
+
+class ErnieScoringEngine(BatchingEngine):
+    """Dynamic-batching fill-in-blank / SOP-scoring over one ERNIE
+    model (module docstring)."""
+
+    def __init__(self, model, variables, *,
+                 mask_token_id: Optional[int] = None,
+                 max_masks: Optional[int] = None, **kw):
+        self.capabilities = ModelCapabilities(
+            family="ernie",
+            has_kv_cache=False,
+            supports_spec=False,
+            cache_layout="none",
+            max_input=int(model.cfg.max_position_embeddings),
+        )
+        super().__init__(model, variables, **kw)
+        self.mask_token_id = (mask_token_id if mask_token_id is not None
+                              else _env_int("FLEETX_ERNIE_MASK_ID", 3))
+        self.max_masks = max(1, max_masks if max_masks is not None
+                             else _env_int("FLEETX_ERNIE_MAX_MASKS", 8))
+
+        def fwd(params, ids, mask, positions):
+            mlm, sop = model.apply({"params": params}, ids,
+                                   attention_mask=mask,
+                                   masked_positions=positions,
+                                   deterministic=True)
+            return (jax.numpy.argmax(mlm, axis=-1),
+                    jax.numpy.argmax(sop, axis=-1))
+
+        self._fwd = jax.jit(fwd)
+
+    def _validate(self, prompt: np.ndarray) -> None:
+        if prompt.size > self.cache_len:
+            raise ValueError(
+                f"prompt of {prompt.size} tokens exceeds the ERNIE input "
+                f"capacity ({self.cache_len})")
+        n_masks = int((prompt == self.mask_token_id).sum())
+        if n_masks > self.max_masks:
+            raise ValueError(
+                f"prompt holds {n_masks} mask tokens but the engine "
+                f"gathers at most {self.max_masks} "
+                "(FLEETX_ERNIE_MAX_MASKS)")
+
+    def _run_batch(self, requests) -> List[List[int]]:
+        pad_id = int(self.model.cfg.pad_token_id)
+        b = _bucket(len(requests), self.slots)
+        length = _bucket(max(r.prompt.size for r in requests),
+                         self.cache_len)
+        ids = np.full((b, length), pad_id, np.int32)
+        mask = np.zeros((b, length), np.int32)
+        # fixed-size mask gather: pad with position 0 (rows with fewer
+        # masks read garbage there; emission slices to the true count)
+        positions = np.zeros((b, self.max_masks), np.int32)
+        counts = []
+        for i, r in enumerate(requests):
+            ids[i, :r.prompt.size] = r.prompt
+            mask[i, :r.prompt.size] = 1
+            where = np.flatnonzero(r.prompt == self.mask_token_id)
+            positions[i, :where.size] = where
+            counts.append(int(where.size))
+        mlm_ids, sop_ids = self._fwd(self.params, ids, mask, positions)
+        mlm_ids = np.asarray(mlm_ids)
+        sop_ids = np.asarray(sop_ids)
+        out = []
+        for i, n in enumerate(counts):
+            if n:
+                out.append([int(t) for t in mlm_ids[i, :n]])
+            else:
+                out.append([int(sop_ids[i])])  # scoring mode: SOP verdict
+        return out

@@ -280,6 +280,15 @@ class ServingMetrics:
             "fleetx_serving_prefill_stall_ms",
             "Milliseconds a tick spent on prefill work (admissions + "
             "chunks) before its batched decode ran")
+        # dynamic-batching engines (serving/batch_engine.py): coalesced
+        # forwards and how full each one ran — the KV-free analogue of
+        # active-slot occupancy
+        self._c_batched_forwards = counter(
+            "fleetx_serving_batched_forwards_total",
+            "Coalesced batched forwards run by a KV-free engine")
+        self._h_batch_occ = hist(
+            "fleetx_serving_batch_occupancy",
+            "Fraction of the coalescing window filled per batched forward")
         self._reasons: Dict[str, object] = {}  # reason -> counter child
         self._first_token_t: Optional[float] = None
         self._last_token_t: Optional[float] = None
@@ -324,6 +333,12 @@ class ServingMetrics:
     def record_drain_reject(self) -> None:
         """A submit was refused because the engine is shutting down."""
         self._c_drain_rejects.inc()
+
+    def record_batched_forward(self, batch: int, capacity: int) -> None:
+        """A KV-free engine ran one coalesced forward over ``batch``
+        requests with room for ``capacity``."""
+        self._c_batched_forwards.inc()
+        self._h_batch_occ.observe(batch / max(capacity, 1))
 
     def record_prefix(self, shared_tokens: int, prompt_tokens: int,
                       pages: int) -> None:

@@ -1,0 +1,224 @@
+"""The model-agnostic serving protocol: what a model must provide to be
+served, and what an engine must provide to be routed.
+
+The serving stack grew GPT-shaped end to end (PRs 2–17): the engine
+called ``init_decode_cache`` / ``decode_step`` directly, the router
+assumed every replica decodes autoregressively, and the API layer
+reported one hardcoded model. The source paper's premise is a ONE-STOP
+toolkit — GPT, ERNIE, ViT, MoCo — so this module factors the two
+implicit contracts into explicit ones:
+
+**The model-side contract** (:class:`ModelExecutor`): the four seams
+``ServingEngine`` actually needs from a model — init cache, the
+bucketed prefill / decode forward, and per-row sampling — plus
+:class:`ModelCapabilities` flags that say which engine features the
+model can legally ride (KV cache, speculative decoding, cache layout).
+:class:`GPTExecutor` is the existing GPT path behind that interface:
+every method delegates to the exact functions the engine called before
+the extraction (``fleetx_tpu/models/gpt/generation.py`` +
+``serving/engine.py``'s shared sampler), so the refactor is provably
+behavior-free — the byte-parity suites run unchanged.
+
+**The engine-side contract** (:data:`ENGINE_SURFACE`): the
+submit/step/healthz surface ``ServingRouter`` and ``ApiServer`` consume.
+Three engine kinds implement it today — the autoregressive
+``ServingEngine`` (GPT), the encoder-style ``ErnieScoringEngine``
+(fill-in-blank / sentence-order scoring; no decode loop), and the
+KV-free ``EmbeddingEngine`` (ViT/MoCo dynamic batching; no cache at
+all). ``tests/test_protocol.py`` runs one conformance suite against all
+three; :func:`engine_conforms` is the structural check it (and the
+router, defensively) uses.
+
+Capability flags ride the ``/healthz`` report (``model`` +
+``capabilities`` keys), which is how a cross-process router learns what
+each replica serves without importing its model code — the same
+scrape-don't-import discipline as the ``role`` field
+(docs/SERVING.md "Heterogeneous fleet").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+__all__ = [
+    "ENGINE_SURFACE",
+    "GPTExecutor",
+    "ModelCapabilities",
+    "ModelExecutor",
+    "engine_conforms",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelCapabilities:
+    """What engine features a served model family can legally ride.
+
+    The flags gate features at CONSTRUCTION, not mid-request: an engine
+    asked to speculate over a model whose executor says
+    ``supports_spec=False`` must refuse up front with a cause, the same
+    fail-at-the-seam discipline as the mesh validation."""
+
+    #: model family name — the router's grouping key and the id prefix
+    #: the API layer lists in ``/v1/models`` ("gpt" | "ernie" | "vit" ...)
+    family: str
+    #: the model decodes autoregressively against a KV cache; False means
+    #: the engine owns no cache pool and every request is one forward
+    has_kv_cache: bool
+    #: draft-and-verify speculative decoding is legal (requires a decode
+    #: loop whose verify call replays multi-token windows — GPT only)
+    supports_spec: bool
+    #: "slot+paged" (the GPT engine's two cache layouts), "none" (KV-free)
+    cache_layout: str
+    #: hard per-request input bound (tokens for text, flat elements for
+    #: vision) — what the router's per-group submit validation prices
+    max_input: int
+    #: what the int32 output channel carries: "tokens" (real token /
+    #: class ids) or "floats" (a float32 vector bit-cast losslessly —
+    #: serving/embedding_engine.py's wire encoding). The API layer keys
+    #: ``/v1/embeddings`` eligibility on this, not on KV-freeness —
+    #: ERNIE is KV-free but token-out
+    emits: str = "tokens"
+
+    def as_dict(self) -> dict:
+        """JSON-ready form for the ``/healthz`` report."""
+        return dataclasses.asdict(self)
+
+
+class ModelExecutor:
+    """The model-side serving contract (abstract).
+
+    ``ServingEngine`` consumes ONLY this surface for model compute: a
+    fresh cache (:meth:`init_cache`), the cached forward that serves
+    both bucketed prefill and the decode tick (:meth:`forward`), and
+    the shared per-row sampling pipeline (:meth:`sample` /
+    :meth:`filter`). Encoder-style engines (ERNIE, ViT) do not run a
+    decode loop and need none of this — they call their model directly
+    — but still advertise :attr:`capabilities` so the router and
+    ``/healthz`` treat every replica uniformly.
+
+    All methods are traced under ``jax.jit``: implementations must be
+    pure functions of their arguments (plus the model closed over at
+    construction)."""
+
+    capabilities: ModelCapabilities
+
+    def bind(self, model):
+        """Rebind to a decode-configured model clone. The engine patches
+        cache length / page layout onto ``model.cfg`` before tracing
+        anything; executors built over the raw model get this call with
+        the clone so :meth:`init_cache` / :meth:`forward` read the
+        serving cache config, not the training one."""
+        raise NotImplementedError
+
+    def init_cache(self, batch: int):
+        """A fresh decode cache for ``batch`` lanes (None when
+        ``capabilities.has_kv_cache`` is False)."""
+        raise NotImplementedError
+
+    def forward(self, params, cache, ids, positions, mask=None, *,
+                cache_positions=None, block_tables=None):
+        """One cached forward: ``(logits, new_cache)``. Serves bucketed
+        prefill (multi-token ``ids``) and the decode tick (one token per
+        lane) through the same seam; ``cache_positions`` are per-lane
+        write offsets, ``block_tables`` the paged indirection (None on
+        the slot path)."""
+        raise NotImplementedError
+
+    def sample(self, logits, keys, greedy, temperature, top_k, top_p, *,
+               topk_cap: int):
+        """Per-row sampling: each row applies its own strategy knobs and
+        draws from its own rng key; returns int32 tokens."""
+        raise NotImplementedError
+
+    def filter(self, logits, temperature, top_k, top_p, *, topk_cap: int):
+        """The sampling filter pipeline alone (speculative verification
+        needs the filtered distribution, not a draw)."""
+        raise NotImplementedError
+
+
+class GPTExecutor(ModelExecutor):
+    """The GPT decode path behind the protocol — pure delegation.
+
+    Every method forwards to the exact function the engine called
+    before the extraction, with the model closed over; tracing under
+    ``jit`` produces identical programs, which is what keeps the
+    byte-parity suites green unchanged."""
+
+    def __init__(self, model, family: str = "gpt"):
+        self.model = model
+        self.capabilities = ModelCapabilities(
+            family=family,
+            has_kv_cache=True,
+            supports_spec=True,
+            cache_layout="slot+paged",
+            max_input=int(model.cfg.max_position_embeddings),
+        )
+
+    def bind(self, model):
+        return GPTExecutor(model, family=self.capabilities.family)
+
+    def init_cache(self, batch: int):
+        from fleetx_tpu.models.gpt.generation import init_decode_cache
+
+        return init_decode_cache(self.model, batch)
+
+    def forward(self, params, cache, ids, positions, mask=None, *,
+                cache_positions=None, block_tables=None):
+        from fleetx_tpu.models.gpt.generation import decode_step
+
+        return decode_step(self.model, params, cache, ids, positions, mask,
+                           cache_positions=cache_positions,
+                           block_tables=block_tables)
+
+    def sample(self, logits, keys, greedy, temperature, top_k, top_p, *,
+               topk_cap: int):
+        from fleetx_tpu.serving.engine import sample_tokens
+
+        return sample_tokens(logits, keys, greedy, temperature, top_k,
+                             top_p, topk_cap=topk_cap)
+
+    def filter(self, logits, temperature, top_k, top_p, *, topk_cap: int):
+        from fleetx_tpu.serving.engine import filter_logits
+
+        return filter_logits(logits, temperature, top_k, top_p,
+                             topk_cap=topk_cap)
+
+
+#: The engine-side contract: every serving engine kind — autoregressive
+#: or not — exposes this surface, and the router/API layers consume
+#: NOTHING else. Methods: the names below; attributes: ``role``
+#: ("prefill"/"decode"/"both"), ``paged`` (bool), ``page_size``,
+#: ``cache_len``, ``slots``, ``model`` (with ``.cfg``), ``metrics``
+#: (``ServingMetrics``-shaped), ``capabilities``
+#: (:class:`ModelCapabilities`), ``model_family`` (str), and
+#: ``submit_limit`` (the smallest REJECTED per-request input size — the
+#: router's per-group admission bound). ``health()`` returns the
+#: ``/healthz`` JSON body: ``state`` ok/draining/dead, ``role``,
+#: ``model``, ``capabilities``, ``queue_depth``, ``queue_tokens``,
+#: ``active``, ``slots``.
+ENGINE_SURFACE = (
+    "submit", "step", "take_result", "result", "cancel", "emitted_tokens",
+    "health", "drain", "shutdown", "request_shutdown", "declare_dead",
+)
+
+_ENGINE_ATTRS = ("role", "paged", "page_size", "cache_len", "slots",
+                 "model", "metrics", "capabilities", "model_family",
+                 "submit_limit")
+
+
+def engine_conforms(engine, *, require_attrs: bool = True
+                    ) -> Optional[str]:
+    """Structural conformance check against :data:`ENGINE_SURFACE`:
+    returns None when ``engine`` exposes the full router-facing
+    contract, else the first missing member's name (the conformance
+    tests and the router's construction-time validation both report
+    it)."""
+    for name in ENGINE_SURFACE:
+        if not callable(getattr(engine, name, None)):
+            return name
+    if require_attrs:
+        for name in _ENGINE_ATTRS:
+            if not hasattr(engine, name):
+                return name
+    return None

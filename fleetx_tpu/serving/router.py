@@ -158,6 +158,10 @@ class _Replica:
     # "both"): prefill replicas get fresh prompts priced in queue
     # TOKENS and are polled for finished prefills to hand off
     role: str = "both"
+    # model family served (the /healthz ``model`` key): dispatch filters
+    # by it BEFORE load/affinity — a GPT prompt never lands on an ERNIE
+    # replica, and fallback stays inside the family group
+    model: str = "gpt"
     probe_failures: int = 0          # consecutive non-ok probes
     next_probe_tick: int = 0         # backoff schedule while suspect
     dispatched: Dict[int, int] = dataclasses.field(default_factory=dict)
@@ -171,6 +175,7 @@ class _RouterRequest:
 
     rid: int
     prompt: np.ndarray
+    model: str                    # family group this request dispatches to
     kw: Dict                      # engine submit kwargs (decode knobs)
     rng_key: jax.Array            # SAME key at every dispatch (RNG parity)
     on_token: Optional[object]
@@ -377,7 +382,8 @@ class ServingRouter:
         if not replicas:
             raise ValueError("router needs at least one replica")
         self._replicas = [_Replica(index=i, engine=e,
-                                   role=getattr(e, "role", "both"))
+                                   role=getattr(e, "role", "both"),
+                                   model=getattr(e, "model_family", "gpt"))
                           for i, e in enumerate(replicas)]
         self.max_queue = (max_queue if max_queue is not None
                           else _env_int("FLEETX_ROUTER_MAX_QUEUE", 0))
@@ -406,13 +412,26 @@ class ServingRouter:
         page_sizes = {e.page_size for e in replicas if e.paged}
         self._affinity_page = min(page_sizes) if page_sizes else 0
         self._affinity_map: Dict[int, int] = {}  # prefix hash -> replica
-        # the tightest per-request capacity across the fleet, so caller
+        # the tightest per-request capacity PER MODEL GROUP, so caller
         # mistakes (over-long prompts, unservable strategies) raise AT
         # SUBMIT like the engine's contract — not as a delayed
-        # finish_reason="error" result out of the first dispatch
-        self._limit = min(
-            min(e.cache_len, e.model.cfg.max_position_embeddings)
-            for e in replicas)
+        # finish_reason="error" result out of the first dispatch.
+        # ``submit_limit`` is the protocol seam (the smallest REJECTED
+        # size); the getattr fallback keeps pre-protocol engine doubles
+        # (tests, RPC proxies) working on the old cache/position formula
+        self._limits: Dict[str, int] = {}
+        for rep in self._replicas:
+            e = rep.engine
+            lim = getattr(e, "submit_limit", None)
+            if lim is None:
+                lim = min(e.cache_len,
+                          e.model.cfg.max_position_embeddings)
+            self._limits[rep.model] = min(
+                self._limits.get(rep.model, lim), lim)
+        # single-model callers never name a family: replica 0's group is
+        # the default, which on a homogeneous fleet is the whole fleet
+        self._default_model = self._replicas[0].model
+        self._limit = self._limits[self._default_model]
         self._base_key = jax.random.PRNGKey(base_seed)
         self.metrics = metrics or RouterMetrics()
         self._queue: List[_RouterRequest] = []
@@ -433,12 +452,16 @@ class ServingRouter:
                top_k: Optional[int] = None, top_p: Optional[float] = None,
                seed: Optional[int] = None, on_token=None,
                queue_ttl_s: Optional[float] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               model: Optional[str] = None) -> int:
         """Queue one request; returns its router-level id. The kwargs
         mirror ``ServingEngine.submit`` (they are forwarded verbatim at
         every dispatch); ``seed`` pins the request's sampling stream —
         the SAME key re-sends at each migration, which is what makes
-        sampling failover RNG-position-exact. Raises
+        sampling failover RNG-position-exact. ``model`` names the family
+        group to dispatch into (default: replica 0's family, so
+        single-model callers never change); an unserved family raises
+        ValueError at submit, loudly. Raises
         :class:`QueueFull` at the ``FLEETX_ROUTER_MAX_QUEUE`` bound and
         :class:`ShuttingDown` after :meth:`shutdown` began."""
         if self._shutting_down:
@@ -462,10 +485,17 @@ class ServingRouter:
                 f"decode_strategy {decode_strategy!r} not servable by "
                 "continuous batching (beam search needs one-shot "
                 "generate())")
-        if prompt.size >= self._limit:
+        if model is None:
+            model = self._default_model
+        if model not in self._limits:
             raise ValueError(
-                f"prompt_len {prompt.size} leaves no decode room on any "
-                f"replica (tightest cache/position limit {self._limit})")
+                f"model {model!r} is not served by this fleet (serving: "
+                f"{sorted(self._limits)})")
+        if prompt.size >= self._limits[model]:
+            raise ValueError(
+                f"prompt_len {prompt.size} is not servable by any "
+                f"{model!r} replica (tightest per-request limit "
+                f"{self._limits[model]})")
         rid = self._next_id
         self._next_id += 1
         rng_key = (jax.random.PRNGKey(int(seed)) if seed is not None
@@ -481,7 +511,7 @@ class ServingRouter:
                 kw[name] = value
         now = self._now()
         req = _RouterRequest(
-            rid=rid, prompt=prompt, kw=kw, rng_key=rng_key,
+            rid=rid, prompt=prompt, model=model, kw=kw, rng_key=rng_key,
             on_token=on_token, submit_time=now, queued_since=now,
             queue_ttl_s=float(queue_ttl_s if queue_ttl_s is not None
                               else self.queue_ttl_s),
@@ -654,9 +684,11 @@ class ServingRouter:
                 continue
             report = self._probe(rep)
             state = report.get("state", "dead")
-            # roles ride the health report so a cross-process router
-            # learns placement phases from the same /healthz scrape
+            # roles and model families ride the health report so a
+            # cross-process router learns placement phases AND grouping
+            # from the same /healthz scrape
             rep.role = report.get("role", rep.role)
+            rep.model = report.get("model", rep.model)
             if state == "ok":
                 if rep.state == ReplicaState.SUSPECT:
                     self._rejoin(rep)
@@ -862,8 +894,12 @@ class ServingRouter:
         fresh prompts prefer prefill-role replicas when any are in
         rotation, falling back to the full fleet when the prefill tier
         is gone or saturated — degraded but never stuck."""
+        # model group FIRST: cross-family dispatch is never a fallback
+        # (an ERNIE replica cannot degrade-serve a GPT prompt) — the
+        # exclude/refusal loop above this stays group-local by design
         candidates = [r for r in self._replicas
                       if r.state == ReplicaState.OK
+                      and r.model == req.model
                       and r.index not in exclude]
         if not candidates:
             return None, False
@@ -1090,27 +1126,49 @@ class ServingRouter:
           (dispatched ones keep ticking — their draining replicas retire
           them under the engine grace window).
 
-        A suspect replica blocks both: it may rejoin."""
-        states = {r.state for r in self._replicas}
-        if states & {ReplicaState.OK, ReplicaState.SUSPECT}:
+        A suspect replica blocks both: it may rejoin. On a
+        heterogeneous fleet the judgment is PER MODEL GROUP — dispatch
+        never crosses families, so a group with no live replicas has
+        stranded its requests even while other families keep serving."""
+        live = {ReplicaState.OK, ReplicaState.SUSPECT}
+        by_model: Dict[str, set] = {}
+        for r in self._replicas:
+            by_model.setdefault(r.model, set()).add(r.state)
+        dead_models, closed_models = set(), set()
+        for m, states in by_model.items():
+            if states & live:
+                continue
+            (dead_models if states == {ReplicaState.DEAD}
+             else closed_models).add(m)
+        if not dead_models and not closed_models:
             return 0
-        all_dead = states == {ReplicaState.DEAD}
         stranded = 0
-        for req in list(self._queue):
-            self._finalize(req, "error" if all_dead else "shutdown")
-            stranded += 1
-        self._queue = []
-        if all_dead:
-            for req in self._requests.values():
-                if req.state == "dispatched":  # died with their replicas
-                    self._finalize(req, "error")
-                    stranded += 1
-            if stranded:
-                obs_emit("router_stranded", requests=stranded,
-                         router=self.metrics.router_label)
-                logger.error(
-                    "router: every replica is dead; %d request(s) "
-                    "stranded with finish_reason='error'", stranded)
+        keep: List[_RouterRequest] = []
+        for req in self._queue:
+            # a family the fleet no longer reports at all counts as dead
+            if req.model in dead_models or req.model not in by_model:
+                self._finalize(req, "error")
+                stranded += 1
+            elif req.model in closed_models:
+                self._finalize(req, "shutdown")
+                stranded += 1
+            else:
+                keep.append(req)
+        self._queue = keep
+        errored = 0
+        for req in self._requests.values():
+            if (req.state == "dispatched"
+                    and req.model in dead_models):  # died with the group
+                self._finalize(req, "error")
+                stranded += 1
+                errored += 1
+        if dead_models and (errored or stranded):
+            obs_emit("router_stranded", requests=stranded,
+                     models=sorted(dead_models),
+                     router=self.metrics.router_label)
+            logger.error(
+                "router: every replica serving %s is dead; %d "
+                "request(s) stranded", sorted(dead_models), stranded)
         return stranded
 
     def _finalize(self, req: _RouterRequest, reason: str) -> None:
@@ -1135,6 +1193,26 @@ class ServingRouter:
     def replica_states(self) -> List[str]:
         """Per-replica lifecycle state, by index."""
         return [r.state for r in self._replicas]
+
+    def models(self) -> Dict[str, Dict]:
+        """Per-family replica-group view — what ``/v1/models`` serves:
+        ``{family: {replicas, live, capabilities, limit}}``.
+        ``capabilities`` comes from the first replica of the group that
+        advertises any (None for pre-protocol engine doubles);
+        ``limit`` is the group's smallest rejected input size."""
+        out: Dict[str, Dict] = {}
+        for rep in self._replicas:
+            info = out.setdefault(rep.model, {
+                "replicas": [], "live": 0, "capabilities": None,
+                "limit": self._limits.get(rep.model, self._limit)})
+            info["replicas"].append(rep.index)
+            if rep.state in (ReplicaState.OK, ReplicaState.SUSPECT):
+                info["live"] += 1
+            if info["capabilities"] is None:
+                caps = getattr(rep.engine, "capabilities", None)
+                if caps is not None:
+                    info["capabilities"] = caps.as_dict()
+        return out
 
     @property
     def queue_depth(self) -> int:

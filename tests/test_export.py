@@ -150,6 +150,13 @@ def test_engine_generate_delegates_to_serving(gen_engine_factory, monkeypatch):
     np.testing.assert_array_equal(out, want)
 
 
+@pytest.mark.slow  # 10.1s (PR 18 tier-1 budget audit): compiles the
+# generate path three times (plain, mp2 mesh, dp2 mesh). The
+# mesh-sharded serving parity contract stays tier-1 via
+# test_mesh_serving.py (byte parity + cache-bytes halving on the mp
+# mesh), and the delegate-vs-one-shot seam stays tier-1 via
+# test_engine_generate_delegates_to_serving; only the mesh matrix of
+# that same seam rides the slow tier.
 def test_engine_generate_mesh_sharded(gen_engine_factory, eight_devices):
     """generate() must honor self.mesh like predict() does (the old code
     ran unsharded): same greedy tokens, sharded over a dp x mp mesh.

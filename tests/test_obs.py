@@ -135,6 +135,13 @@ def test_span_ring_is_bounded_and_survives_exceptions():
     assert [s.attrs["i"] for s in rec.spans()] == list(range(12, 20))
 
 
+@pytest.mark.slow  # 14.8s (PR 18 tier-1 budget audit): spins up the
+# real jax profiler just to see the bridge's annotation land in a
+# Chrome trace. The span contract itself (nesting, export, bounded
+# ring, exception safety) stays tier-1 via
+# test_spans_nest_and_export_chrome_trace and
+# test_span_ring_is_bounded_and_survives_exceptions; only the
+# profiler-integration acceptance rides the slow tier.
 def test_trace_annotation_bridge_reaches_profiler_trace(tmp_path):
     """Acceptance: host-side spans appear in a jax profiler Chrome trace
     via the TraceAnnotation bridge (so serving/train phases line up with
@@ -361,9 +368,14 @@ def test_healthz_json_body_carries_rotate_out_reason():
         gen_cfg=GenerationConfig(decode_strategy="greedy",
                                  eos_token_id=10**6, pad_token_id=60,
                                  max_length=4))
+    # model + capabilities joined the report in PR 18 (the model-aware
+    # router's advertisement channel, docs/SERVING.md "Heterogeneous
+    # fleet") — the load/rotate-out fields this test pins are unchanged
     assert eng.health() == {"state": "ok", "role": "both", "queue_depth": 0,
                             "queue_tokens": 0, "active": 0, "slots": 2,
-                            "pages_in_use": 0, "usable_pages": 2}
+                            "pages_in_use": 0, "usable_pages": 2,
+                            "model": "gpt",
+                            "capabilities": eng.capabilities.as_dict()}
     eng.submit(np.asarray([1, 2, 3], np.int32), max_length=4)
     assert eng.health()["queue_depth"] == 1
     srv = ObsServer(port=0).start()

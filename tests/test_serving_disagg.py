@@ -521,6 +521,13 @@ def test_router_disagg_parity_roles_and_health(model_and_params):
     assert any(d.get("role") == "prefill" for d in body["detail"].values())
 
 
+@pytest.mark.slow  # 14.4s (PR 18 tier-1 budget audit): three full
+# router workloads back to back to walk every rung in one test. Each
+# rung's contract stays tier-1 on its own: export-fault/crc fallback
+# via test_export_admit_parity, the prefill-replica death + replay via
+# test_decode_replica_recovers_shipped_admissions, disagg routing +
+# health via test_router_disagg_parity_roles_and_health; the combined
+# ladder also runs end-to-end in chaos_check's serving_disagg scenario.
 def test_router_fallback_ladder(model_and_params):
     """Every rung degrades to replay, never to wrong bytes: an export
     fault mid-handoff, a blob corrupted in flight (caught by the wire

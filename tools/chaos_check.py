@@ -64,7 +64,14 @@ per scenario, non-zero exit on any failure:
   flight: the front door's stream completes through the router's
   cross-process RPC migration, byte-identical to a clean in-process
   engine — zero tokens lost or duplicated — and ``replica_dead`` +
-  ``request_migrated`` events are banked.
+  ``request_migrated`` events are banked;
+- ``serving_hetero``: a HETEROGENEOUS fleet (2 GPT + 2 ViT embedding
+  replicas behind one model-aware router) with a GPT replica killed
+  mid-stream AND an embedding replica killed mid-batch: every request
+  of both families reaches exactly one terminal result, migrated GPT
+  streams are byte-identical to a clean single replica, embedding bits
+  match a lone-engine reference, and dispatch never crosses model
+  families (asserted on every prompt each engine ever saw).
 
 Usage::
 
@@ -972,6 +979,125 @@ def scenario_serving_http(tmp):
             "identical through RPC migration (zero loss/dup)")
 
 
+def scenario_serving_hetero(tmp):
+    """Heterogeneous fleet under fire: a GPT replica killed mid-stream
+    AND an embedding replica killed mid-batch in the SAME router —
+    every request of both families still reaches exactly one terminal
+    result, migrated GPT streams stay byte-identical to a clean single
+    replica, and dispatch never crosses model families."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fleetx_tpu.models.vision.vit import ViT, ViTConfig
+    from fleetx_tpu.obs import get_event_log
+    from fleetx_tpu.resilience.faults import faults
+    from fleetx_tpu.serving import (
+        EmbeddingEngine,
+        ServingRouter,
+        decode_floats,
+        encode_floats,
+    )
+
+    make, prompts = _serving_fixture()
+    clean, _, _ = _run_workload(make(True), prompts)
+
+    vcfg = ViTConfig(image_size=8, patch_size=4, in_channels=3,
+                     num_classes=0, hidden_size=32, num_layers=2,
+                     num_attention_heads=2, drop_rate=0.0,
+                     attn_drop_rate=0.0, dtype=jnp.float32,
+                     use_flash_attention=False)
+    vit = ViT(vcfg)
+    shape = (8, 8, 3)
+    vit_vars = jax.jit(vit.init)(jax.random.PRNGKey(1),
+                                 np.zeros((1,) + shape, np.float32))
+    rng = np.random.RandomState(7)
+    images = [rng.rand(*shape).astype(np.float32) for _ in range(4)]
+
+    def make_emb():
+        return EmbeddingEngine(vit, vit_vars, slots=2)
+
+    # clean embedding bits from a lone engine — the determinism
+    # reference the post-kill fleet must reproduce
+    ref_emb = make_emb()
+    ref_rids = [ref_emb.submit(encode_floats(img)) for img in images]
+    ref_res = ref_emb.drain()
+    ref_bits = [np.asarray(ref_res[r].tokens) for r in ref_rids]
+
+    # every prompt each engine ever sees, for the cross-model gate: GPT
+    # prompts are a few tokens, embedding prompts are H*W*C=192 wire
+    # ints — a single misrouted request is unambiguous in these logs
+    seen = {"gpt": [], "vit": []}
+
+    def tap(eng, fam):
+        orig = eng.submit
+
+        def submit(prompt, **kw):
+            seen[fam].append(int(np.asarray(prompt).size))
+            return orig(prompt, **kw)
+
+        eng.submit = submit
+        return eng
+
+    # fleet layout: replicas 0-1 GPT, 2-3 embedding. Kill the embedding
+    # replica 2 at tick 1 — its coalesced batch is dispatched but has
+    # not run yet, so the whole in-flight batch must migrate — and GPT
+    # replica 1 at tick 3, mid-stream with tokens already emitted.
+    faults.configure(replica_kill="2:1,1:3")
+    try:
+        router = ServingRouter(
+            [tap(make(True), "gpt"), tap(make(True), "gpt"),
+             tap(make_emb(), "vit"), tap(make_emb(), "vit")],
+            probe_every=1)
+        rids = []  # (family, index, rid)
+        for i, (p, img) in enumerate(zip(prompts, images)):
+            rids.append(("gpt", i, router.submit(p, max_length=8,
+                                                 model="gpt")))
+            rids.append(("vit", i, router.submit(encode_floats(img),
+                                                 model="vit")))
+        res = router.drain(max_ticks=500)
+    finally:
+        faults.reset()
+    assert len(res) == len(rids), (
+        f"{len(rids)} submitted, {len(res)} terminal results — "
+        "requests were lost or duplicated")
+    for fam, i, rid in rids:
+        if fam == "gpt":
+            assert np.array_equal(np.asarray(res[rid].tokens), clean[i]), (
+                f"GPT request {rid} diverged from the clean single "
+                "replica after the mid-stream kill")
+        else:
+            assert res[rid].finish_reason == "complete", res[rid]
+            assert np.array_equal(np.asarray(res[rid].tokens),
+                                  ref_bits[i]), (
+                f"embedding request {rid} bits diverged after the "
+                "mid-batch kill")
+            assert decode_floats(res[rid].tokens).size == vcfg.hidden_size
+    # cross-model gate: no GPT engine ever saw an image-sized prompt
+    # and no embedding engine ever saw a text-sized one
+    img_elems = int(np.prod(shape))
+    assert seen["gpt"] and all(n < 16 for n in seen["gpt"]), seen["gpt"]
+    assert seen["vit"] and all(n == img_elems for n in seen["vit"]), \
+        seen["vit"]
+    ev = get_event_log()
+    for replica in (1, 2):
+        assert ev.find("fault_injected", fault="replica_kill",
+                       replica=replica), \
+            f"kill injection on replica {replica} left no event"
+        assert ev.find("replica_dead", replica=replica), \
+            f"replica {replica} death left no replica_dead event"
+    assert ev.find("request_migrated"), "failover left no request_migrated"
+    m = router.metrics.snapshot()
+    assert m["replica_deaths"] == 2 and m["migrated"] >= 2, m
+    groups = router.models()
+    assert groups["gpt"]["live"] == 1 and groups["vit"]["live"] == 1, groups
+    return (f"killed GPT replica 1 mid-stream + embedding replica 2 "
+            f"mid-batch; {len(rids)}/{len(rids)} exactly-one-result, "
+            f"{m['migrated']} migrated, GPT byte-identical, embedding "
+            f"bits identical, zero cross-model dispatches "
+            f"({len(seen['gpt'])} gpt / {len(seen['vit'])} vit submits)")
+
+
 SCENARIOS = {
     "sentry": scenario_sentry,
     "sentry_zero": scenario_sentry_zero,
@@ -988,6 +1114,7 @@ SCENARIOS = {
     "router_saturation": scenario_router_saturation,
     "serving_disagg": scenario_serving_disagg,
     "serving_http": scenario_serving_http,
+    "serving_hetero": scenario_serving_hetero,
 }
 
 
