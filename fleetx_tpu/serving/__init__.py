@@ -26,9 +26,14 @@ surface), ``batch_engine`` / ``ernie_engine`` / ``embedding_engine``
 (KV-free dynamic-batching engines for encoder-style models), ``metrics``
 (queue/TTFT/throughput/prefix-reuse observability), ``router``
 (N-replica dispatch with per-model groups, health-based failover,
-zero-token-loss migration), ``workload`` (seeded trace generation + the
-SLO goodput scorer). docs/SERVING.md has the architecture tour.
+zero-token-loss migration, and per-tenant QoS: DRR weighted-fair lanes,
+admission budgets, priority preemption), ``autoscaler`` (closed-loop
+fleet sizing off replica health with prefix pre-warm), ``workload``
+(seeded trace generation — Poisson or heavy-tailed Azure-LLM-shaped —
++ the SLO goodput scorer). docs/SERVING.md has the architecture tour.
 """
+
+from fleetx_tpu.serving.autoscaler import FleetAutoscaler
 
 from fleetx_tpu.serving.cache_manager import (
     DiskPageStore,
@@ -67,6 +72,7 @@ from fleetx_tpu.serving.router import (
     ReplicaState,
     RouterMetrics,
     ServingRouter,
+    TenantPolicy,
 )
 from fleetx_tpu.serving.scheduler import FIFOScheduler, Request
 from fleetx_tpu.serving.spec import (
@@ -75,8 +81,10 @@ from fleetx_tpu.serving.spec import (
     Proposer,
 )
 from fleetx_tpu.serving.workload import (
+    DISTRIBUTIONS,
     RequestOutcome,
     TenantSpec,
+    TraceDistribution,
     TraceRequest,
     WorkloadSpec,
     generate_trace,
@@ -113,12 +121,16 @@ __all__ = [
     "DraftModelProposer",
     "NgramProposer",
     "Proposer",
+    "DISTRIBUTIONS",
+    "FleetAutoscaler",
     "ReplicaState",
     "RequestOutcome",
     "RouterMetrics",
     "ServingMetrics",
     "ServingRouter",
+    "TenantPolicy",
     "TenantSpec",
+    "TraceDistribution",
     "TraceRequest",
     "WorkloadSpec",
     "generate_trace",

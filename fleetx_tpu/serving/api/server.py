@@ -131,13 +131,15 @@ class _ApiMetrics:
         reg = get_registry()
         self.requests = reg.counter(
             "fleetx_api_requests_total",
-            "API requests accepted per route", ("route",))
+            "API requests accepted per route and tenant",
+            ("route", "tenant"))
         self.errors = reg.counter(
             "fleetx_api_errors_total",
             "API error responses per HTTP status", ("code",))
         self.tokens = reg.counter(
             "fleetx_api_tokens_total",
-            "Completion tokens delivered to API clients")
+            "Completion tokens delivered to API clients per tenant",
+            ("tenant",))
         self.active = reg.gauge(
             "fleetx_api_active_requests",
             "API requests currently in flight (streaming or aggregating)")
@@ -406,6 +408,19 @@ class ApiServer(HttpDaemon):
             raise ApiError(400, "stream must be a boolean")
         return ids, kw
 
+    def _tenant_of(self, handler: _ApiHandler, body: Dict) -> str:
+        """The tenant identity from the auth/header seam: an
+        ``X-Fleetx-Tenant`` header (what an authenticating reverse proxy
+        stamps after validating the API key) wins; the OpenAI-compatible
+        ``user`` body field is the fallback; anonymous traffic shares
+        the ``"default"`` lane. The value feeds the per-tenant metric
+        labels and — when the target is the QoS router — its dispatch
+        lane, budgets, and rate limits (docs/SERVING.md)."""
+        t = handler.headers.get("X-Fleetx-Tenant") or body.get("user")
+        if not isinstance(t, str):
+            return "default"
+        return t.strip()[:64] or "default"
+
     def _submit(self, ids: List[int], kw: Dict, sink) -> int:
         """Submit under the lock, mapping engine refusals onto HTTP."""
         from fleetx_tpu.serving.engine import QueueFull, ShuttingDown
@@ -425,8 +440,13 @@ class ApiServer(HttpDaemon):
         """One ``/v1/*completions`` request end to end (validate →
         submit → stream or aggregate → respond)."""
         ids, kw = self._parse(body, chat)
+        tenant = self._tenant_of(handler, body)
         route = "chat" if chat else "completions"
-        self.metrics.requests.labels(route=route).inc()
+        self.metrics.requests.labels(route=route, tenant=tenant).inc()
+        if getattr(self.target, "supports_tenants", False):
+            # the QoS router's per-tenant lane/budget seam; plain
+            # engines never see the kwarg
+            kw["tenant"] = tenant
 
         q: "queue.Queue" = queue.Queue()
 
@@ -440,9 +460,11 @@ class ApiServer(HttpDaemon):
         try:
             rid = self._submit(ids, kw, sink)
             if body.get("stream", False):
-                self._respond_stream(handler, q, rid, ids, chat, t0)
+                self._respond_stream(handler, q, rid, ids, chat, t0,
+                                     tenant)
             else:
-                self._respond_json(handler, q, rid, ids, chat, t0)
+                self._respond_json(handler, q, rid, ids, chat, t0,
+                                   tenant)
         finally:
             with self._inflight_lock:
                 self._inflight -= 1
@@ -488,7 +510,9 @@ class ApiServer(HttpDaemon):
             raise ApiError(
                 400, "input must be a non-empty array of numbers (one "
                 "flattened image/vector) or an array of such arrays")
-        self.metrics.requests.labels(route="embeddings").inc()
+        tenant = self._tenant_of(handler, body)
+        self.metrics.requests.labels(route="embeddings",
+                                     tenant=tenant).inc()
         with self._inflight_lock:
             self._inflight += len(rows)
         self.metrics.active.inc()
@@ -506,7 +530,8 @@ class ApiServer(HttpDaemon):
                 pending.append(
                     (q, self._submit(ids, dict(model=model), sink)))
             for index, (q, rid) in enumerate(pending):
-                result = self._await_result(q, rid, t0, lambda _t: None)
+                result = self._await_result(q, rid, t0, lambda _t: None,
+                                            tenant)
                 if result.finish_reason != "complete":
                     raise ApiError(
                         503 if result.finish_reason in ("shutdown",
@@ -529,7 +554,8 @@ class ApiServer(HttpDaemon):
             self.metrics.active.inc(-1)
 
     def _await_result(self, q: "queue.Queue", rid: int, t0: float,
-                      on_token: Callable[[int], None]):
+                      on_token: Callable[[int], None],
+                      tenant: str = "default"):
         """Pump the token queue until the request's result is ready.
 
         Tokens arrive via the queue (the driver thread ticks the target,
@@ -541,13 +567,14 @@ class ApiServer(HttpDaemon):
         first = True
         deadline = t0 + self.request_timeout_s
         result = None
+        tokens_c = self.metrics.tokens.labels(tenant=tenant)
         while result is None:
             try:
                 tok, finished = q.get(timeout=0.05)
                 if first:
                     self.metrics.ttft.observe(time.monotonic() - t0)
                     first = False
-                self.metrics.tokens.inc()
+                tokens_c.inc()
                 on_token(tok)
                 if not finished:
                     continue
@@ -575,16 +602,17 @@ class ApiServer(HttpDaemon):
                 tok, _fin = q.get_nowait()
             except queue.Empty:
                 break
-            self.metrics.tokens.inc()
+            tokens_c.inc()
             on_token(tok)
         return result
 
     # ------------------------------------------------------- responders
 
-    def _respond_json(self, handler, q, rid, ids, chat, t0) -> None:
+    def _respond_json(self, handler, q, rid, ids, chat, t0,
+                      tenant: str = "default") -> None:
         """Aggregate (non-stream) response."""
         toks: List[int] = []
-        result = self._await_result(q, rid, t0, toks.append)
+        result = self._await_result(q, rid, t0, toks.append, tenant)
         text = self.decode([int(t) for t in result.tokens])
         finish = _FINISH_MAP.get(result.finish_reason,
                                  result.finish_reason)
@@ -611,7 +639,8 @@ class ApiServer(HttpDaemon):
                 "tokens": [int(t) for t in result.tokens]}
         handler._send_json(200, payload)
 
-    def _respond_stream(self, handler, q, rid, ids, chat, t0) -> None:
+    def _respond_stream(self, handler, q, rid, ids, chat, t0,
+                        tenant: str = "default") -> None:
         """SSE streaming response: one chunk per decoded token (with the
         raw id in the ``token`` extension field), a final chunk carrying
         ``finish_reason``, then ``data: [DONE]``."""
@@ -655,7 +684,7 @@ class ApiServer(HttpDaemon):
                     self.target.cancel(rid)
                 raise BrokenPipeError("client disconnected mid-stream")
 
-        result = self._await_result(q, rid, t0, on_token)
+        result = self._await_result(q, rid, t0, on_token, tenant)
         finish = _FINISH_MAP.get(result.finish_reason,
                                  result.finish_reason)
         write_event(chunk(None, finish))

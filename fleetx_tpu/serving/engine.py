@@ -1041,6 +1041,51 @@ class ServingEngine:
         obs_emit("request_cancelled", request=request_id)
         return True
 
+    def prewarm(self, prompt) -> int:
+        """Pull ``prompt``'s prefix pages out of the host/disk tiers into
+        the device trie BEFORE this engine takes traffic (the
+        autoscaler's scale-up pre-warm, docs/SERVING.md "Per-tenant QoS &
+        autoscaling"). A fresh replica sharing a :class:`DiskPageStore`
+        with the fleet starts with a cold device trie but a warm store;
+        this revives the longest already-persisted prefix through the
+        normal alloc path (revived pages carry real K/V) and immediately
+        frees the lane, parking the pages zero-ref-warm in the trie — so
+        the replica's first real request prefix-hits instead of
+        re-prefilling. Returns the number of prefix tokens now warm
+        (0: not paged / no prefix cache / nothing persisted / pool busy).
+
+        Deliberately NEVER registers fresh pages: only pages revived
+        with actual K/V may enter the trie, or later matches would serve
+        garbage."""
+        if not (self.paged and self.prefix_cache):
+            return 0
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0 or prompt.size >= self.cache_len:
+            return 0
+        pool = self.cache_manager.pool
+        chunks = pool._chunks(prompt)
+        path = pool._match_path(chunks)
+        warm = pool._match_host(chunks, path)
+        covered = len(path) + len(warm)
+        if covered == 0:
+            return 0
+        # alloc() shares at most (n-1)//page_size full chunks, so to
+        # claim all `covered` warm chunks the probe prompt must span one
+        # token PAST them (capped by the real prompt)
+        n = min(int(prompt.size), covered * self.page_size + 1)
+        if not self.cache_manager.can_admit(prompt[:n]):
+            return 0
+        got = self.cache_manager.alloc(-1, prompt[:n])
+        if got is None:
+            return 0
+        lane, shared = got
+        # free() parks the revived (now zero-ref) pages warm in the trie
+        self.cache_manager.free(lane)
+        if shared:
+            obs_emit("prefix_prewarmed", engine=self.metrics.engine_label,
+                     tokens=int(shared))
+        return int(shared)
+
     def _expire_queued(self, now):
         """Retire queued requests whose queue-TTL/deadline passed (they
         never get a slot; ``finish_reason="timeout"``, empty tokens)."""

@@ -9,10 +9,27 @@ slice, docs/SERVING.md "Mesh-sharded serving") and turns the library
 into a deployable service whose availability story does not end at one
 process's ``recover()``.
 
-**Dispatch.** Requests queue in the router (bounded by
-``FLEETX_ROUTER_MAX_QUEUE`` — a full queue rejects with
-:class:`~fleetx_tpu.serving.engine.QueueFull`, the same explicit
-backpressure contract as the engine) and dispatch FIFO to the
+**Dispatch.** Requests queue in PER-TENANT lanes (``submit(tenant=...)``,
+threaded from the API's ``X-Fleetx-Tenant`` header) and dispatch by
+deficit round robin over the lanes: each scheduling round grants every
+backlogged lane a token quantum scaled by its :class:`TenantPolicy`
+weight, and a lane spends its accumulated deficit on its own FIFO head
+(cost = prompt tokens + decode budget), so a flooding tenant can at most
+consume its weighted share while everyone else keeps draining. Lanes
+with a higher ``priority`` dispatch strictly first, and a paid lane's
+deadline-at-risk request may PREEMPT a lower-priority in-flight request
+through the same cancel + ``submit(history=...)`` machinery migration
+uses — the victim re-queues at its OWN lane head with its delivered
+tokens as history, so preemption never loses a token (the
+exactly-one-result invariant is untouched: preemption is a migration
+with a different trigger). ``dispatch="fifo"``
+(``FLEETX_ROUTER_DISPATCH``) restores the old single-FIFO order — the
+bench's DRR-vs-FIFO A/B. Admission is bounded per lane AND fleet-wide
+(``FLEETX_ROUTER_MAX_QUEUE``): a tenant past its lane bound, request
+rate, or token budget sheds with
+:class:`~fleetx_tpu.serving.engine.QueueFull` scoped to ITS lane — the
+flooding tenant absorbs its own backpressure instead of the fleet's.
+Placement of each dispatched request goes to the
 least-loaded in-rotation replica, scored by its health report's
 ``queue_depth + active``. PREFIX AFFINITY pins sessions to warm caches:
 the hash of a prompt's longest full-page prefix maps to the replica
@@ -112,10 +129,11 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import os
 import time
 import weakref
 import zlib
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -135,7 +153,56 @@ from fleetx_tpu.serving.engine import (
 from fleetx_tpu.serving.metrics import _drop_series
 from fleetx_tpu.utils.log import logger
 
-__all__ = ["ReplicaState", "RouterMetrics", "ServingRouter"]
+__all__ = ["ReplicaState", "RouterMetrics", "ServingRouter", "TenantPolicy"]
+
+#: lane every request without an explicit tenant lands in — one default
+#: lane makes DRR degenerate to the old single FIFO, so tenant-less
+#: callers keep byte-identical dispatch order
+DEFAULT_TENANT = "default"
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantPolicy:
+    """Admission + scheduling policy for one tenant's router lane
+    (docs/SERVING.md "Per-tenant QoS & autoscaling").
+
+    ``weight`` scales the lane's deficit-round-robin quantum — its
+    guaranteed share of dispatch tokens under contention. ``priority``
+    orders strict dispatch tiers (higher dispatches first) and is what
+    arms preemption. ``rate_rps`` / ``token_budget`` are per-second
+    admission buckets (requests and cost tokens respectively; 0 = no
+    limit) refilled continuously on the router clock; ``max_queue``
+    bounds THIS tenant's lane (0 = unbounded). Every limit sheds with a
+    lane-scoped :class:`QueueFull` — the tenant that exceeds its
+    contract absorbs its own backpressure. ``preempt`` arms the
+    deadline-at-risk preemption path (None = armed iff priority > 0)."""
+
+    weight: float = 1.0
+    priority: int = 0
+    rate_rps: float = 0.0
+    token_budget: float = 0.0
+    max_queue: int = 0
+    preempt: Optional[bool] = None
+
+    @property
+    def preempts(self) -> bool:
+        """Whether this lane's deadline-at-risk requests may preempt."""
+        return self.priority > 0 if self.preempt is None else self.preempt
+
+
+@dataclasses.dataclass
+class _TenantLane:
+    """One tenant's FIFO queue + DRR deficit + admission-bucket state."""
+
+    name: str
+    policy: TenantPolicy
+    queue: List["_RouterRequest"] = dataclasses.field(default_factory=list)
+    deficit: float = 0.0
+    # token buckets: level is "how much is available now", refilled
+    # continuously from the policy rates on the router's swappable clock
+    rate_level: float = 0.0
+    budget_level: float = 0.0
+    refilled: Optional[float] = None
 
 
 class ReplicaState:
@@ -198,6 +265,8 @@ class _RouterRequest:
     # consumed by the next dispatch (cleared on success OR on a decode-
     # side ValueError — the replay fallback never re-sends bad blobs)
     kv_payloads: Optional[list] = None
+    tenant: str = DEFAULT_TENANT
+    preemptions: int = 0          # times evicted for a higher-priority lane
 
 
 class RouterMetrics:
@@ -260,10 +329,45 @@ class RouterMetrics:
         self._c_shed = counter(
             "fleetx_router_shed_total",
             "Queued requests shed by queue-TTL/deadline expiry")
+        self._c_preempted = counter(
+            "fleetx_router_preempted_total",
+            "In-flight requests preempted for a higher-priority lane's "
+            "deadline-at-risk request (zero-loss: victims re-queue with "
+            "history)")
         self._finished_family = reg.counter(
             "fleetx_router_finished_total",
             "Requests that reached their one terminal result, by reason",
             ("router", "reason"))
+        # per-tenant QoS families, labeled (router, tenant) — children
+        # materialize lazily per tenant seen, owned for finalize-cleanup
+        tl = ("router", "tenant")
+        self._tenant_families = {
+            "queue_depth": reg.gauge(
+                "fleetx_router_tenant_queue_depth",
+                "Requests waiting in this tenant's router lane", tl),
+            "shed": reg.counter(
+                "fleetx_router_tenant_shed_total",
+                "This tenant's requests refused at admission (lane bound, "
+                "rate, token budget) or shed from its lane by "
+                "TTL/deadline", tl),
+            "preempted": reg.counter(
+                "fleetx_router_tenant_preempted_total",
+                "This tenant's in-flight requests preempted by a "
+                "higher-priority lane", tl),
+            "dispatched": reg.counter(
+                "fleetx_router_tenant_dispatched_total",
+                "Dispatches of this tenant's requests (migrations "
+                "re-count)", tl),
+            "tokens": reg.counter(
+                "fleetx_router_tenant_tokens_total",
+                "Tokens delivered in this tenant's terminal results", tl),
+            "goodput_share": reg.gauge(
+                "fleetx_router_tenant_goodput_share",
+                "This tenant's fraction of all tokens this router "
+                "delivered", tl),
+        }
+        self._tenant_children: Dict[Tuple[str, str], object] = {}
+        self._per_tenant: Dict[str, Dict[str, int]] = {}
         self._h_ttft = hist(
             "fleetx_router_ttft_seconds",
             "Router submit -> first token on the host (end-to-end across "
@@ -277,23 +381,70 @@ class RouterMetrics:
         self._reasons: Dict[str, object] = {}
         weakref.finalize(self, _drop_series, owned)
 
-    def record_reject(self) -> None:
-        """A submit was refused by the bounded router queue."""
-        self._c_rejected.inc()
+    def _tenant_child(self, key: str, tenant: str):
+        """Memoized per-tenant child of one QoS family (owned for the
+        weakref-finalize cleanup like every other child)."""
+        child = self._tenant_children.get((key, tenant))
+        if child is None:
+            labels = {"router": self.router_label, "tenant": tenant}
+            fam = self._tenant_families[key]
+            self._owned.append((fam, labels))
+            child = fam.labels(**labels)
+            self._tenant_children[(key, tenant)] = child
+        return child
 
-    def record_shed(self) -> None:
+    def _tenant_stats(self, tenant: str) -> Dict[str, int]:
+        return self._per_tenant.setdefault(
+            tenant, {"shed": 0, "preempted": 0, "dispatched": 0,
+                     "tokens": 0})
+
+    def record_reject(self, tenant: str = DEFAULT_TENANT) -> None:
+        """A submit was refused at admission (queue bound/rate/budget)."""
+        self._c_rejected.inc()
+        self._tenant_stats(tenant)["shed"] += 1
+        self._tenant_child("shed", tenant).inc()
+
+    def record_shed(self, tenant: str = DEFAULT_TENANT) -> None:
         """A queued request was shed by TTL/deadline expiry."""
         self._c_shed.inc()
+        self._tenant_stats(tenant)["shed"] += 1
+        self._tenant_child("shed", tenant).inc()
 
     def record_probe_failure(self) -> None:
         """A health probe returned non-ok or raised."""
         self._c_probe_failures.inc()
 
-    def record_dispatch(self, affinity: bool) -> None:
+    def record_dispatch(self, affinity: bool,
+                        tenant: str = DEFAULT_TENANT) -> None:
         """One dispatch placed (``affinity`` = via the prefix pin)."""
         self._c_dispatched.inc()
         if affinity:
             self._c_affinity.inc()
+        self._tenant_stats(tenant)["dispatched"] += 1
+        self._tenant_child("dispatched", tenant).inc()
+
+    def record_preempted(self, victim_tenant: str) -> None:
+        """One in-flight request preempted for a higher-priority lane."""
+        self._c_preempted.inc()
+        self._tenant_stats(victim_tenant)["preempted"] += 1
+        self._tenant_child("preempted", victim_tenant).inc()
+
+    def observe_tenant_queue(self, tenant: str, depth: int) -> None:
+        """Per-tick lane-depth gauge sample."""
+        self._tenant_child("queue_depth", tenant).set(depth)
+
+    def record_tenant_tokens(self, tenant: str, n_tokens: int) -> None:
+        """Terminal result delivered ``n_tokens`` to ``tenant``; refresh
+        every tenant's delivered-token share gauge."""
+        st = self._tenant_stats(tenant)
+        st["tokens"] += int(n_tokens)
+        if n_tokens:
+            self._tenant_child("tokens", tenant).inc(int(n_tokens))
+        total = sum(s["tokens"] for s in self._per_tenant.values())
+        if total:
+            for t, s in self._per_tenant.items():
+                self._tenant_child("goodput_share", t).set(
+                    s["tokens"] / total)
 
     def record_migrated(self) -> None:
         """One in-flight request migrated off its replica."""
@@ -352,6 +503,8 @@ class RouterMetrics:
             "probe_failures": int(self._c_probe_failures.value),
             "rejected": int(self._c_rejected.value),
             "shed": int(self._c_shed.value),
+            "preempted": int(self._c_preempted.value),
+            "per_tenant": {t: dict(s) for t, s in self._per_tenant.items()},
             "finished": sum(self.finish_reasons.values()),
             "finish_reasons": self.finish_reasons,
             "ttft_s_p50": ttft_p50,
@@ -368,6 +521,12 @@ class ServingRouter:
     only consumes the submit/step/health/result surface."""
 
     _AFFINITY_CAP = 65536  # prefix pins kept (insertion-ordered, oldest out)
+    _HOT_PREFIX_CAP = 32   # most-reused prefixes tracked for prewarming
+    _MAX_DRR_ROUNDS = 4096  # converges far earlier; loud loop backstop
+
+    #: capability flag the API server probes before threading
+    #: ``submit(tenant=...)`` — plain engines don't take the kwarg
+    supports_tenants = True
 
     def __init__(self, replicas, *, max_queue: Optional[int] = None,
                  queue_ttl_s: Optional[float] = None,
@@ -378,7 +537,12 @@ class ServingRouter:
                  hedge: Optional[bool] = None,
                  affinity: Optional[bool] = None,
                  base_seed: int = 0,
-                 metrics: Optional[RouterMetrics] = None):
+                 metrics: Optional[RouterMetrics] = None,
+                 tenants: Optional[Dict[str, TenantPolicy]] = None,
+                 dispatch: Optional[str] = None,
+                 preempt: Optional[bool] = None,
+                 preempt_risk_frac: Optional[float] = None,
+                 drr_quantum: Optional[int] = None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         self._replicas = [_Replica(index=i, engine=e,
@@ -434,7 +598,29 @@ class ServingRouter:
         self._limit = self._limits[self._default_model]
         self._base_key = jax.random.PRNGKey(base_seed)
         self.metrics = metrics or RouterMetrics()
-        self._queue: List[_RouterRequest] = []
+        # ---- per-tenant QoS dispatch (module docstring "Dispatch") ----
+        self.dispatch_mode = (
+            dispatch if dispatch is not None
+            else os.environ.get("FLEETX_ROUTER_DISPATCH", "drr"))
+        if self.dispatch_mode not in ("drr", "fifo"):
+            raise ValueError(
+                f"dispatch mode {self.dispatch_mode!r} (want drr|fifo)")
+        self.preempt_enabled = (
+            preempt if preempt is not None
+            else _env_int("FLEETX_ROUTER_PREEMPT", 1) == 1)
+        self.preempt_risk_frac = max(0.0, (
+            preempt_risk_frac if preempt_risk_frac is not None
+            else _env_float("FLEETX_ROUTER_PREEMPT_RISK_FRAC", 0.5)))
+        self.drr_quantum = max(1, (
+            drr_quantum if drr_quantum is not None
+            else _env_int("FLEETX_ROUTER_DRR_QUANTUM", 256)))
+        self._tenant_policies: Dict[str, TenantPolicy] = dict(tenants or {})
+        self._lanes: Dict[str, _TenantLane] = {}
+        for name in self._tenant_policies:  # eager: stable DRR lane order
+            self._lane(name)
+        # most-reused full-page prefixes seen at submit — what a freshly
+        # spawned replica prewarms from the shared page store
+        self._hot_prefixes: Dict[int, list] = {}  # key -> [prefix, hits]
         self._requests: Dict[int, _RouterRequest] = {}
         self._results: Dict[int, ServingResult] = {}
         self._next_id = 0
@@ -453,7 +639,8 @@ class ServingRouter:
                seed: Optional[int] = None, on_token=None,
                queue_ttl_s: Optional[float] = None,
                deadline_s: Optional[float] = None,
-               model: Optional[str] = None) -> int:
+               model: Optional[str] = None,
+               tenant: Optional[str] = None) -> int:
         """Queue one request; returns its router-level id. The kwargs
         mirror ``ServingEngine.submit`` (they are forwarded verbatim at
         every dispatch); ``seed`` pins the request's sampling stream —
@@ -461,20 +648,25 @@ class ServingRouter:
         sampling failover RNG-position-exact. ``model`` names the family
         group to dispatch into (default: replica 0's family, so
         single-model callers never change); an unserved family raises
-        ValueError at submit, loudly. Raises
-        :class:`QueueFull` at the ``FLEETX_ROUTER_MAX_QUEUE`` bound and
-        :class:`ShuttingDown` after :meth:`shutdown` began."""
+        ValueError at submit, loudly. ``tenant`` names the QoS lane the
+        request queues in (default: the shared ``"default"`` lane);
+        admission enforces that lane's :class:`TenantPolicy` bounds.
+        Raises :class:`QueueFull` at the fleet-wide
+        ``FLEETX_ROUTER_MAX_QUEUE`` bound or any per-lane limit (the
+        message names the lane) and :class:`ShuttingDown` after
+        :meth:`shutdown` began."""
         if self._shutting_down:
             raise ShuttingDown(
                 "router is shutting down; submit to another cluster")
-        if self.max_queue and len(self._queue) >= self.max_queue:
+        tenant = tenant if tenant else DEFAULT_TENANT
+        if self.max_queue and self.queue_depth >= self.max_queue:
             self._shed_expired(self._now())  # dead entries don't hold slots
-        if self.max_queue and len(self._queue) >= self.max_queue:
-            self.metrics.record_reject()
+        if self.max_queue and self.queue_depth >= self.max_queue:
+            self.metrics.record_reject(tenant)
             obs_emit("queue_reject", router=self.metrics.router_label,
-                     queue_depth=len(self._queue))
+                     queue_depth=self.queue_depth, tenant=tenant)
             raise QueueFull(
-                f"router queue is full ({len(self._queue)}/{self.max_queue}"
+                f"router queue is full ({self.queue_depth}/{self.max_queue}"
                 " waiting); retry later or raise FLEETX_ROUTER_MAX_QUEUE")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
@@ -496,6 +688,8 @@ class ServingRouter:
                 f"prompt_len {prompt.size} is not servable by any "
                 f"{model!r} replica (tightest per-request limit "
                 f"{self._limits[model]})")
+        lane = self._lane(tenant)
+        self._admit_lane(lane, prompt, max_length)
         rid = self._next_id
         self._next_id += 1
         rng_key = (jax.random.PRNGKey(int(seed)) if seed is not None
@@ -518,10 +712,126 @@ class ServingRouter:
             deadline_s=float(deadline_s if deadline_s is not None
                              else self.deadline_s),
             affinity_key=self._affinity_key(prompt),
+            tenant=tenant,
         )
         self._requests[rid] = req
-        self._queue.append(req)
+        lane.queue.append(req)
+        if req.affinity_key is not None:
+            self._note_hot_prefix(req.affinity_key, prompt)
         return rid
+
+    # --------------------------------------------- tenant lanes (QoS)
+
+    def _lane(self, tenant: str) -> _TenantLane:
+        """The tenant's lane, created on first sight with its configured
+        :class:`TenantPolicy` (or the open default policy)."""
+        lane = self._lanes.get(tenant)
+        if lane is None:
+            lane = self._lanes[tenant] = _TenantLane(
+                name=tenant,
+                policy=self._tenant_policies.get(tenant, TenantPolicy()))
+        return lane
+
+    def _cost(self, req: _RouterRequest) -> float:
+        """DRR/budget cost of one request in TOKENS: prompt plus the
+        decode budget it asked for (the same units prefill replicas are
+        priced in — a flooding tenant pays for the work it books, not
+        the requests it counts)."""
+        return float(req.prompt.size) + float(
+            req.kw.get("max_length", 0) or 0)
+
+    def _refill_buckets(self, lane: _TenantLane, now: float) -> None:
+        """Continuous token-bucket refill on the router clock. Burst
+        capacity is one second's worth of each rate — enough to absorb
+        a bursty arrival at the contracted average."""
+        pol = lane.policy
+        if lane.refilled is None:
+            lane.rate_level = max(pol.rate_rps, 1.0)
+            lane.budget_level = pol.token_budget
+        else:
+            dt = max(0.0, now - lane.refilled)
+            lane.rate_level = min(max(pol.rate_rps, 1.0),
+                                  lane.rate_level + dt * pol.rate_rps)
+            lane.budget_level = min(pol.token_budget,
+                                    lane.budget_level
+                                    + dt * pol.token_budget)
+        lane.refilled = now
+
+    def _admit_lane(self, lane: _TenantLane, prompt: np.ndarray,
+                    max_length: Optional[int]) -> None:
+        """Per-lane admission control: lane queue bound, request-rate
+        bucket, token-budget bucket. Every refusal is a
+        :class:`QueueFull` scoped to THIS lane — the tenant exceeding
+        its contract sheds its own requests, never the fleet's."""
+        pol = lane.policy
+        why = None
+        if pol.max_queue and len(lane.queue) >= pol.max_queue:
+            why = (f"lane is full ({len(lane.queue)}/{pol.max_queue} "
+                   "waiting)")
+        else:
+            now = self._now()
+            self._refill_buckets(lane, now)
+            cost = float(prompt.size) + float(max_length or 0)
+            if pol.rate_rps and lane.rate_level < 1.0:
+                why = f"request rate above {pol.rate_rps}/s"
+            elif pol.token_budget and lane.budget_level < cost:
+                why = (f"token budget exhausted (request costs "
+                       f"{cost:.0f} tokens, {lane.budget_level:.0f} "
+                       f"available at {pol.token_budget}/s)")
+            else:
+                if pol.rate_rps:
+                    lane.rate_level -= 1.0
+                if pol.token_budget:
+                    lane.budget_level -= cost
+        if why is not None:
+            self.metrics.record_reject(lane.name)
+            obs_emit("queue_reject", router=self.metrics.router_label,
+                     tenant=lane.name, queue_depth=len(lane.queue))
+            raise QueueFull(f"tenant {lane.name!r}: {why}; retry later "
+                            "or raise this tenant's TenantPolicy limits")
+
+    def _queued(self) -> List[_RouterRequest]:
+        """Queued requests across every lane in global submission order
+        (migrated/preempted re-queues sit at their lane heads and carry
+        the oldest rids, so rid order IS the legacy single-FIFO order)."""
+        out = [r for lane in self._lanes.values() for r in lane.queue]
+        out.sort(key=lambda r: r.rid)
+        return out
+
+    def _prune_lanes(self) -> None:
+        """Drop dispatched/finalized requests out of every lane queue."""
+        for lane in self._lanes.values():
+            if any(r.state != "queued" for r in lane.queue):
+                lane.queue = [r for r in lane.queue if r.state == "queued"]
+
+    def _requeue_head(self, reqs: List[_RouterRequest]) -> None:
+        """Re-queue migrated/continued requests at their OWN lane heads
+        in submission order (the lane-aware version of the old
+        head-of-queue prepend)."""
+        for req in sorted(reqs, key=lambda r: r.rid, reverse=True):
+            self._lane(req.tenant).queue.insert(0, req)
+
+    def _note_hot_prefix(self, key: int, prompt: np.ndarray) -> None:
+        """Track the most-reused full-page prefixes (bounded): the warm
+        set :meth:`hot_prefixes` hands the autoscaler for prewarming a
+        fresh replica's trie from the shared page store."""
+        ent = self._hot_prefixes.get(key)
+        if ent is not None:
+            ent[1] += 1
+            return
+        n = (prompt.size // self._affinity_page) * self._affinity_page
+        self._hot_prefixes[key] = [np.ascontiguousarray(prompt[:n]), 1]
+        while len(self._hot_prefixes) > self._HOT_PREFIX_CAP:
+            coldest = min(self._hot_prefixes,
+                          key=lambda k: self._hot_prefixes[k][1])
+            del self._hot_prefixes[coldest]
+
+    def hot_prefixes(self, k: int = 8) -> List[np.ndarray]:
+        """The ``k`` most-reused full-page prompt prefixes this router
+        has admitted — what a freshly spawned replica prewarms from the
+        shared :class:`DiskPageStore` before taking traffic."""
+        ents = sorted(self._hot_prefixes.values(), key=lambda e: -e[1])
+        return [e[0] for e in ents[:k]]
 
     def _affinity_key(self, prompt: np.ndarray) -> Optional[int]:
         """Hash of the longest FULL-page prompt prefix (None when
@@ -552,12 +862,14 @@ class ServingRouter:
         finished, migrated = self._tick_replicas()
         stranded = self._strand_if_no_replicas()
         in_rotation = sum(r.state == ReplicaState.OK for r in self._replicas)
-        self.metrics.observe_tick(len(self._queue), len(self._replicas),
+        self.metrics.observe_tick(self.queue_depth, len(self._replicas),
                                   in_rotation)
+        for lane in self._lanes.values():
+            self.metrics.observe_tenant_queue(lane.name, len(lane.queue))
         return {"dispatched": dispatched, "finished": finished,
                 "migrated": migrated, "handoff": handoff,
                 "shed": shed + stranded,
-                "queue_depth": len(self._queue),
+                "queue_depth": self.queue_depth,
                 "in_rotation": in_rotation,
                 "replica_states": [r.state for r in self._replicas]}
 
@@ -604,7 +916,8 @@ class ServingRouter:
                 except Exception:  # noqa: BLE001 — a dying replica is fine
                     pass
         else:
-            self._queue = [r for r in self._queue if r.rid != request_id]
+            lane = self._lane(req.tenant)
+            lane.queue = [r for r in lane.queue if r.rid != request_id]
         self._finalize(req, "cancelled")
         obs_emit("request_cancelled", request=request_id,
                  router=self.metrics.router_label)
@@ -626,9 +939,10 @@ class ServingRouter:
                     pass
         while any(r.state == "dispatched" for r in self._requests.values()):
             self.step()
-        for req in list(self._queue):
+        for req in self._queued():
             self._finalize(req, "shutdown")
-        self._queue = []
+        for lane in self._lanes.values():
+            lane.queue = []
         out, self._results = self._results, {}
         for rid in out:
             self._requests.pop(rid, None)
@@ -642,20 +956,21 @@ class ServingRouter:
         (migrated partials kept) instead of occupying queue slots they
         can no longer use."""
         shed = 0
-        keep = []
-        for req in self._queue:
-            waiting = now - req.queued_since   # THIS queue residency
-            age = now - req.submit_time        # total lifetime
-            if ((req.queue_ttl_s and waiting > req.queue_ttl_s)
-                    or (req.deadline_s and age > req.deadline_s)):
-                self._finalize(req, "timeout")
-                obs_emit("request_timeout", request=req.rid,
-                         where="router_queue")
-                self.metrics.record_shed()
-                shed += 1
-            else:
-                keep.append(req)
-        self._queue = keep
+        for lane in self._lanes.values():
+            keep = []
+            for req in lane.queue:
+                waiting = now - req.queued_since   # THIS queue residency
+                age = now - req.submit_time        # total lifetime
+                if ((req.queue_ttl_s and waiting > req.queue_ttl_s)
+                        or (req.deadline_s and age > req.deadline_s)):
+                    self._finalize(req, "timeout")
+                    obs_emit("request_timeout", request=req.rid,
+                             where="router_queue", tenant=req.tenant)
+                    self.metrics.record_shed(req.tenant)
+                    shed += 1
+                else:
+                    keep.append(req)
+            lane.queue = keep
         return shed
 
     def _probe(self, rep: _Replica) -> Dict:
@@ -797,7 +1112,7 @@ class ServingRouter:
             obs_emit("request_migrated", request=rid, replica=rep.index,
                      tokens=len(req.tokens), why=why)
         rep.dispatched = {}
-        self._queue = moved + self._queue
+        self._requeue_head(moved)
         return len(moved)
 
     def _handoff(self) -> int:
@@ -853,8 +1168,7 @@ class ServingRouter:
                          replica=rep.index,
                          shipped=req.kv_payloads is not None)
         if moved:
-            moved.sort(key=lambda r: r.rid)
-            self._queue = moved + self._queue
+            self._requeue_head(moved)
         return len(moved)
 
     def _load(self, rep: _Replica) -> float:
@@ -917,25 +1231,145 @@ class ServingRouter:
                    key=lambda r: (loads.get(r.index, 0), r.index)), False
 
     def _dispatch(self) -> int:
-        """FIFO dispatch of the router queue onto in-rotation replicas;
-        a request whose every candidate rejects (queue full/draining)
-        stays queued in arrival order."""
-        dispatched = 0
-        blocked = False
-        remaining: List[_RouterRequest] = []
+        """Dispatch the tenant lanes onto in-rotation replicas —
+        deficit round robin by default, the legacy single FIFO under
+        ``dispatch="fifo"`` (and byte-equivalently under DRR when only
+        the default lane exists)."""
         loads = {r.index: self._load(r) for r in self._replicas
                  if r.state == ReplicaState.OK}
-        for req in self._queue:
-            if blocked:  # preserve FIFO order past the first stuck head
-                remaining.append(req)
-                continue
-            if not self._dispatch_one(req, loads):
-                remaining.append(req)
-                blocked = req.state == "queued"
-            else:
-                dispatched += 1
-        self._queue = [r for r in remaining if r.state == "queued"]
+        if self.dispatch_mode == "fifo":
+            dispatched = self._dispatch_fifo(loads)
+        else:
+            dispatched = self._dispatch_drr(loads)
+        self._prune_lanes()
         return dispatched
+
+    def _dispatch_fifo(self, loads) -> int:
+        """Legacy order: one global FIFO over every lane by submission
+        id; a stuck head blocks everything behind it (strict arrival
+        fairness, no tenant isolation — the bench's DRR baseline)."""
+        dispatched = 0
+        for req in self._queued():
+            if self._dispatch_one(req, loads):
+                dispatched += 1
+            elif req.state == "queued":
+                break  # preserve FIFO order past the first stuck head
+        return dispatched
+
+    def _dispatch_drr(self, loads) -> int:
+        """Deficit round robin over the backlogged lanes, strict
+        priority tiers first. Each round grants every still-active lane
+        ``drr_quantum × weight`` deficit tokens; a lane serves its FIFO
+        head while its deficit covers the head's cost (prompt + decode
+        budget). A head that cannot place (every candidate full) blocks
+        only ITS lane — the other tenants keep draining, which is the
+        whole point. Rounds repeat until every lane is empty, blocked,
+        or nothing moved."""
+        dispatched = 0
+        groups: Dict[int, List[_TenantLane]] = {}
+        for lane in self._lanes.values():
+            if lane.queue:
+                groups.setdefault(lane.policy.priority, []).append(lane)
+        for prio in sorted(groups, reverse=True):
+            lanes = groups[prio]
+            active = {lane.name for lane in lanes}
+            for _ in range(self._MAX_DRR_ROUNDS):
+                progress = False
+                for lane in lanes:
+                    if lane.name not in active:
+                        continue
+                    lane.deficit += self.drr_quantum * max(
+                        lane.policy.weight, 1e-9)
+                    while lane.queue:
+                        head = lane.queue[0]
+                        if head.state != "queued":  # cancelled elsewhere
+                            lane.queue.pop(0)
+                            progress = True
+                            continue
+                        cost = self._cost(head)
+                        if cost > lane.deficit:
+                            break  # next round adds another quantum
+                        if self._dispatch_one(head, loads):
+                            lane.deficit -= cost
+                            lane.queue.pop(0)
+                            dispatched += 1
+                            progress = True
+                        elif head.state == "queued":
+                            # head can't place: lane waits, others go on
+                            active.discard(lane.name)
+                            break
+                        else:  # finalized (timeout/error): drop, go on
+                            lane.queue.pop(0)
+                            progress = True
+                    if not lane.queue:
+                        active.discard(lane.name)
+                        lane.deficit = 0.0  # empty lane banks nothing
+                if not active or not progress:
+                    break
+        return dispatched
+
+    def _try_preempt(self, req: _RouterRequest, exclude: set,
+                     loads) -> bool:
+        """Priority preemption (module docstring): a deadline-at-risk
+        request of a preempting lane evicts the cheapest-to-replay
+        in-flight request of a strictly lower-priority lane in its own
+        model group. The victim is cancelled on its replica and
+        re-queued at its OWN lane head carrying every delivered token as
+        history — exactly the migration path, so zero tokens are lost
+        and the exactly-one-result invariant is untouched. Returns True
+        when a slot was freed (the caller retries placement)."""
+        lane = self._lane(req.tenant)
+        if not (self.preempt_enabled and lane.policy.preempts):
+            return False
+        if not req.deadline_s:
+            return False  # no deadline -> never "at risk"
+        age = self._now() - req.submit_time
+        if age < self.preempt_risk_frac * req.deadline_s:
+            return False
+        victim = None
+        for cand in self._requests.values():
+            if cand.state != "dispatched" or cand.model != req.model:
+                continue
+            if self._lane(cand.tenant).policy.priority >= lane.policy.priority:
+                continue
+            if self._replicas[cand.replica].state != ReplicaState.OK:
+                continue
+            if victim is None or len(cand.tokens) < len(victim.tokens):
+                victim = cand  # fewest emitted tokens = cheapest replay
+        if victim is None:
+            return False
+        vrep = self._replicas[victim.replica]
+        vrep.dispatched.pop(victim.engine_rid, None)
+        try:
+            vrep.engine.cancel(victim.engine_rid)
+            res = vrep.engine.take_result(victim.engine_rid)
+        except Exception:  # noqa: BLE001 — fall back to callback history
+            res = None
+        if res is not None:
+            # engine host truth is the durable history (same re-base the
+            # migration paths use); the callback stream already saw these
+            victim.tokens = [int(t) for t in res.tokens]
+        victim.state = "queued"
+        victim.replica = None
+        victim.engine_rid = None
+        victim.queued_since = self._now()
+        victim.preemptions += 1
+        self._lane(victim.tenant).queue.insert(0, victim)
+        if vrep.role != "prefill":
+            loads[vrep.index] = max(0, loads.get(vrep.index, 1) - 1)
+        exclude.discard(vrep.index)
+        self.metrics.record_preempted(victim.tenant)
+        self.metrics.record_migrated()
+        obs_emit("request_preempted", request=victim.rid,
+                 tenant=victim.tenant, by=req.rid,
+                 by_tenant=req.tenant, replica=vrep.index,
+                 tokens=len(victim.tokens))
+        logger.info(
+            "router: request %d (tenant %s) preempted off replica %d for "
+            "deadline-at-risk request %d (tenant %s); %d tokens carried",
+            victim.rid, victim.tenant, vrep.index, req.rid, req.tenant,
+            len(victim.tokens))
+        return True
 
     def _dispatch_one(self, req: _RouterRequest, loads) -> bool:
         """Try to place one request; True iff it was dispatched (a
@@ -946,6 +1380,14 @@ class ServingRouter:
         only_refusals = True  # no candidate was merely full/draining
         while True:
             rep, via_affinity = self._pick_replica(req, exclude, loads)
+            if rep is None and not only_refusals:
+                # capacity, not validity, is the problem: a preempting
+                # lane may evict lower-priority in-flight work to make
+                # room (then retry this same placement loop once)
+                if self._try_preempt(req, exclude, loads):
+                    only_refusals = True
+                    refused = None
+                    continue
             if rep is None:
                 if refused is not None and only_refusals and exclude:
                     # EVERY in-rotation replica judged the request
@@ -1029,7 +1471,7 @@ class ServingRouter:
                 # under millions of distinct prefixes
                 while len(self._affinity_map) > self._AFFINITY_CAP:
                     self._affinity_map.pop(next(iter(self._affinity_map)))
-            self.metrics.record_dispatch(via_affinity)
+            self.metrics.record_dispatch(via_affinity, req.tenant)
             return True
 
     def _make_cb(self, req: _RouterRequest):
@@ -1110,10 +1552,9 @@ class ServingRouter:
             self._finalize(req, res.finish_reason)
             done += 1
         if continued:
-            # one prepend in submission order — the same head-of-queue
-            # FIFO fairness _migrate_all gives dead-replica migrations
-            continued.sort(key=lambda r: r.rid)
-            self._queue = continued + self._queue
+            # head-of-lane re-queue in submission order — the same
+            # fairness _migrate_all gives dead-replica migrations
+            self._requeue_head(continued)
         return done
 
     def _strand_if_no_replicas(self) -> int:
@@ -1143,18 +1584,19 @@ class ServingRouter:
         if not dead_models and not closed_models:
             return 0
         stranded = 0
-        keep: List[_RouterRequest] = []
-        for req in self._queue:
-            # a family the fleet no longer reports at all counts as dead
-            if req.model in dead_models or req.model not in by_model:
-                self._finalize(req, "error")
-                stranded += 1
-            elif req.model in closed_models:
-                self._finalize(req, "shutdown")
-                stranded += 1
-            else:
-                keep.append(req)
-        self._queue = keep
+        for lane in self._lanes.values():
+            keep: List[_RouterRequest] = []
+            for req in lane.queue:
+                # a family the fleet no longer reports counts as dead
+                if req.model in dead_models or req.model not in by_model:
+                    self._finalize(req, "error")
+                    stranded += 1
+                elif req.model in closed_models:
+                    self._finalize(req, "shutdown")
+                    stranded += 1
+                else:
+                    keep.append(req)
+            lane.queue = keep
         errored = 0
         for req in self._requests.values():
             if (req.state == "dispatched"
@@ -1186,6 +1628,56 @@ class ServingRouter:
             latency_s=now - req.submit_time,
         )
         self.metrics.record_finished(reason, now - req.submit_time)
+        if req.tokens:
+            self.metrics.record_tenant_tokens(req.tenant, len(req.tokens))
+
+    # ------------------------------------------------------- fleet membership
+
+    def add_replica(self, engine) -> int:
+        """Join a new replica to the rotation (the autoscaler's scale-up
+        seam). The engine enters as ``OK`` and is eligible for the very
+        next dispatch; per-model submit limits and the affinity page
+        granularity tighten to include it. Returns the replica index."""
+        rep = _Replica(index=len(self._replicas), engine=engine,
+                       role=getattr(engine, "role", "both"),
+                       model=getattr(engine, "model_family", "gpt"))
+        self._replicas.append(rep)
+        lim = getattr(engine, "submit_limit", None)
+        if lim is None:
+            lim = min(engine.cache_len,
+                      engine.model.cfg.max_position_embeddings)
+        self._limits[rep.model] = min(
+            self._limits.get(rep.model, lim), lim)
+        if rep.model == self._default_model:
+            self._limit = self._limits[self._default_model]
+        if getattr(engine, "paged", False):
+            ps = engine.page_size
+            self._affinity_page = (min(self._affinity_page, ps)
+                                   if self._affinity_page else ps)
+        obs_emit("replica_added", replica=rep.index, model=rep.model,
+                 role=rep.role, router=self.metrics.router_label)
+        logger.info("router: replica %d joined (model=%s role=%s)",
+                    rep.index, rep.model, rep.role)
+        return rep.index
+
+    def remove_replica(self, index: int) -> bool:
+        """Retire a drained replica from the rotation (the autoscaler's
+        scale-down seam). Refuses — returns False — while the replica is
+        still ``OK`` or holds dispatched work: drain it first
+        (``engine.request_shutdown``) so no request is stranded.
+        Indices of the surviving replicas are unchanged."""
+        if not 0 <= index < len(self._replicas):
+            return False
+        rep = self._replicas[index]
+        if rep.state == ReplicaState.OK or rep.dispatched:
+            return False
+        rep.state = ReplicaState.DEAD
+        self._affinity_map = {k: v for k, v in self._affinity_map.items()
+                              if v != index}
+        obs_emit("replica_removed", replica=index,
+                 router=self.metrics.router_label)
+        logger.info("router: replica %d removed from rotation", index)
+        return True
 
     # ---------------------------------------------------------- introspection
 
@@ -1216,8 +1708,8 @@ class ServingRouter:
 
     @property
     def queue_depth(self) -> int:
-        """Requests waiting in the router queue."""
-        return len(self._queue)
+        """Requests waiting across every tenant lane."""
+        return sum(len(lane.queue) for lane in self._lanes.values())
 
     @property
     def in_flight(self) -> int:

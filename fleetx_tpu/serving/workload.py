@@ -17,7 +17,11 @@ regression gate.
 Three pieces, all host-only and engine-agnostic:
 
 - :func:`generate_trace` — a SEEDED, fully deterministic request trace
-  from a :class:`WorkloadSpec`: exponential inter-arrivals at the base
+  from a :class:`WorkloadSpec`: inter-arrivals drawn from the spec's
+  named :class:`TraceDistribution` (``"poisson"`` — exponential gaps,
+  the classic open-loop model — or ``"azure_llm"`` — Weibull gaps with
+  shape < 1 and lognormal-shaped lengths, the heavy-tailed
+  burst-and-lull pattern of the Azure LLM inference traces) at the base
   rate, multiplied during periodic burst windows; tenants drawn by
   weight (bursts pin to the shared-prefix-heaviest tenant — the
   "everyone hits the same template at 9am" shape that exercises prefix
@@ -44,6 +48,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import math
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -52,8 +57,10 @@ import numpy as np
 from fleetx_tpu.serving.engine import QueueFull, ShuttingDown
 
 __all__ = [
+    "DISTRIBUTIONS",
     "RequestOutcome",
     "TenantSpec",
+    "TraceDistribution",
     "TraceRequest",
     "WorkloadSpec",
     "disagg_spec",
@@ -88,7 +95,11 @@ class TenantSpec:
 
 @dataclasses.dataclass(frozen=True)
 class WorkloadSpec:
-    """One seeded workload: arrival process + tenant mix."""
+    """One seeded workload: arrival process + tenant mix.
+
+    ``distribution`` names a :data:`DISTRIBUTIONS` entry shaping the
+    inter-arrival gaps and the length draws — ``"poisson"`` (default,
+    the original synthetic model) or ``"azure_llm"`` (heavy-tailed)."""
 
     seed: int = 0
     n_requests: int = 64
@@ -98,6 +109,66 @@ class WorkloadSpec:
     burst_every_s: float = 0.0                # 0 = no bursts
     burst_len_s: float = 1.0
     burst_factor: float = 4.0                 # arrival-rate multiplier
+    distribution: str = "poisson"             # DISTRIBUTIONS key
+
+
+class TraceDistribution:
+    """The pluggable trace-shape seam: how long until the next arrival,
+    and how long prompts/decodes are within each tenant's configured
+    range. The base class IS the ``"poisson"`` preset — exponential
+    inter-arrivals, uniform lengths — and its rng call pattern is
+    frozen: :func:`generate_trace` draws through these exact methods in
+    a fixed order, so a given (spec, seed) pair reproduces byte-exact
+    traces forever (the banked bench hashes depend on it)."""
+
+    name = "poisson"
+
+    def interarrival(self, rng, rate: float) -> float:
+        """Seconds until the next arrival at ``rate`` req/s mean."""
+        return float(rng.exponential(1.0 / rate))
+
+    def prompt_len(self, rng, lo: int, hi: int) -> int:
+        """Prompt length within the tenant's inclusive range."""
+        return int(rng.integers(lo, hi + 1))
+
+    def gen_len(self, rng, lo: int, hi: int) -> int:
+        """max_new_tokens within the tenant's inclusive range."""
+        return int(rng.integers(lo, hi + 1))
+
+
+class _AzureLLMDistribution(TraceDistribution):
+    """Heavy-tailed preset shaped like the Azure LLM inference traces
+    (arXiv 2404.16283): Weibull inter-arrivals with shape < 1 — many
+    near-simultaneous arrivals separated by long lulls, far burstier
+    than Poisson at the same mean rate — and lognormal-body lengths
+    (most requests short, a fat tail of near-range-max ones). The
+    Weibull scale is normalized by Γ(1 + 1/k) so the MEAN rate still
+    matches ``arrival_rate``: saturation math carries over between
+    presets, only the variance (the hard part) changes."""
+
+    name = "azure_llm"
+    _SHAPE = 0.45      # Weibull k; < 1 = heavy tail
+    _LOGNORM_SPAN = 8.0  # lognormal(0,1) value mapped to range max
+
+    def interarrival(self, rng, rate: float) -> float:
+        scale = (1.0 / rate) / math.gamma(1.0 + 1.0 / self._SHAPE)
+        return float(rng.weibull(self._SHAPE) * scale)
+
+    def _length(self, rng, lo: int, hi: int) -> int:
+        frac = min(float(rng.lognormal(0.0, 1.0)) / self._LOGNORM_SPAN, 1.0)
+        return lo + int(round(frac * (hi - lo)))
+
+    def prompt_len(self, rng, lo: int, hi: int) -> int:
+        return self._length(rng, lo, hi)
+
+    def gen_len(self, rng, lo: int, hi: int) -> int:
+        return self._length(rng, lo, hi)
+
+
+#: Named trace shapes ``WorkloadSpec.distribution`` selects from.
+DISTRIBUTIONS: Dict[str, TraceDistribution] = {
+    d.name: d for d in (TraceDistribution(), _AzureLLMDistribution())
+}
 
 
 @dataclasses.dataclass
@@ -129,6 +200,11 @@ def generate_trace(spec: WorkloadSpec) -> List[TraceRequest]:
         raise ValueError("arrival_rate must be > 0")
     if not spec.tenants:
         raise ValueError("need at least one tenant")
+    if spec.distribution not in DISTRIBUTIONS:
+        raise ValueError(
+            f"unknown distribution {spec.distribution!r} "
+            f"(have {sorted(DISTRIBUTIONS)})")
+    dist = DISTRIBUTIONS[spec.distribution]
     rng = np.random.default_rng(spec.seed)
     # per-tenant shared prefixes drawn FIRST, so adding requests to a
     # spec never reshuffles the prefixes earlier requests share
@@ -148,13 +224,13 @@ def generate_trace(spec: WorkloadSpec) -> List[TraceRequest]:
     for i in range(spec.n_requests):
         rate = spec.arrival_rate * (
             spec.burst_factor if _in_burst(t, spec) else 1.0)
-        t += float(rng.exponential(1.0 / rate))
+        t += dist.interarrival(rng, rate)
         ti = (burst_tenant if _in_burst(t, spec)
               else int(rng.choice(len(spec.tenants), p=weights)))
         tenant = spec.tenants[ti]
         prefix = prefixes.get(tenant.name)
         lo, hi = tenant.prompt_len
-        plen = int(rng.integers(lo, hi + 1))
+        plen = dist.prompt_len(rng, lo, hi)
         if prefix is not None:
             plen = max(plen, len(prefix) + 1)  # at least one fresh token
             suffix = rng.integers(1, spec.vocab, plen - len(prefix),
@@ -165,7 +241,7 @@ def generate_trace(spec: WorkloadSpec) -> List[TraceRequest]:
         glo, ghi = tenant.gen_len
         out.append(TraceRequest(
             index=i, arrival_s=t, tenant=tenant.name, prompt=prompt,
-            max_new_tokens=int(rng.integers(glo, ghi + 1)),
+            max_new_tokens=dist.gen_len(rng, glo, ghi),
             ttft_deadline_s=tenant.ttft_deadline_s,
             tpot_deadline_ms=tenant.tpot_deadline_ms,
             abandon_s=tenant.abandon_s,
@@ -223,6 +299,7 @@ class RequestOutcome:
     tpot_ms: Optional[float] = None   # mean inter-token gap (>= 2 tokens)
     ttft_deadline_s: float = 0.0
     tpot_deadline_ms: float = 0.0
+    tokens: Optional[Tuple[int, ...]] = None  # run_trace(keep_tokens=True)
 
     @property
     def met_ttft(self) -> bool:
@@ -248,15 +325,24 @@ class RequestOutcome:
 
 def run_trace(target, trace: List[TraceRequest], *,
               now=time.perf_counter, submit_kw: Optional[Dict] = None,
-              max_wall_s: float = 300.0) -> List[RequestOutcome]:
+              max_wall_s: float = 300.0,
+              keep_tokens: bool = False) -> List[RequestOutcome]:
     """Replay ``trace`` against ``target`` (engine or router: the
     submit/step/cancel/take_result surface) in real time: each request
     submits at its arrival offset, abandoning tenants cancel past their
     patience, and streaming callbacks time every token. Returns one
     :class:`RequestOutcome` per trace request (``"rejected"`` for
     admission-refused submits). ``max_wall_s`` is a loud runaway guard,
-    not a scheduling knob."""
+    not a scheduling knob.
+
+    A target advertising ``supports_tenants`` (the QoS router, or an
+    HTTP shim forwarding the tenant header) receives each request's
+    trace tenant as ``submit(tenant=...)`` — the seam that lets one
+    trace drive per-tenant dispatch and plain engines alike.
+    ``keep_tokens=True`` records each outcome's full token stream
+    (``RequestOutcome.tokens``) for byte-parity assertions."""
     submit_kw = dict(submit_kw or {})
+    send_tenant = bool(getattr(target, "supports_tenants", False))
     pending = sorted(trace, key=lambda r: (r.arrival_s, r.index))
     live: Dict[int, Dict] = {}  # rid -> record
     outcomes: List[RequestOutcome] = []
@@ -276,10 +362,13 @@ def run_trace(target, trace: List[TraceRequest], *,
             def cb(_rid, _tok, _fin, rec=rec):
                 rec["times"].append(now())
 
+            kw = dict(submit_kw)
+            if send_tenant:
+                kw["tenant"] = tr.tenant
             try:
                 rid = target.submit(tr.prompt,
                                     max_length=tr.max_new_tokens,
-                                    on_token=cb, **submit_kw)
+                                    on_token=cb, **kw)
             except (QueueFull, ShuttingDown):
                 outcomes.append(RequestOutcome(
                     index=tr.index, tenant=tr.tenant,
@@ -310,7 +399,9 @@ def run_trace(target, trace: List[TraceRequest], *,
                 ttft_s=(times[0] - rec["t_submit"]) if times else None,
                 tpot_ms=tpot,
                 ttft_deadline_s=tr.ttft_deadline_s,
-                tpot_deadline_ms=tr.tpot_deadline_ms))
+                tpot_deadline_ms=tr.tpot_deadline_ms,
+                tokens=(tuple(int(t) for t in res.tokens)
+                        if keep_tokens else None)))
         if pi < len(pending) and not live:
             # idle gap before the next arrival: don't burn a core spinning
             gap = pending[pi].arrival_s - (now() - start)

@@ -135,6 +135,12 @@ def test_replica_kill_failover_byte_parity(tiny):
     assert router.replica_states[1] == "dead"
 
 
+@pytest.mark.slow  # 10.0s (PR 19 tier-1 budget audit): the rotate-out/
+# escalation half stays tier-1 via test_probe_escalation_marks_dead_and_
+# migrates (same probe_flap injector, byte parity on the survivor); the
+# flap-REJOIN half (replica_back, never dead) stays tier-1 via
+# test_router_qos.py::test_preemption_churn_conservation, whose seed-1
+# leg flaps replica 0 mid-churn and asserts replica_back with no death
 def test_probe_flap_rotates_out_and_back_never_dead(tiny):
     """A health probe lying for fewer than FLEETX_ROUTER_PROBE_MAX
     probes costs a rotation round-trip (replica_out then replica_back),

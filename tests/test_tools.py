@@ -112,17 +112,20 @@ def test_bench_serving_records_schema(monkeypatch):
     want.append("gpt_345m_serving_router_slo")
     want.append("gpt_345m_serving_disagg")
     want.append("gpt_345m_serving_hetero")
+    want.append("gpt_345m_serving_router_qos")
     assert [r["metric"] for r in recs] == want
     static, cont, shared, faulted, int8, chunked, spec = recs[:7]
     mesh = recs[7] if has_mesh else None
-    sweep = recs[-4]
-    router = recs[-3]
-    disagg = recs[-2]
-    hetero = recs[-1]
+    sweep = recs[-5]
+    router = recs[-4]
+    disagg = recs[-3]
+    hetero = recs[-2]
+    qos = recs[-1]
     for r in recs:
         if r["metric"] in ("gpt_345m_serving_router_slo",
                            "gpt_345m_serving_disagg",
-                           "gpt_345m_serving_hetero"):
+                           "gpt_345m_serving_hetero",
+                           "gpt_345m_serving_router_qos"):
             continue  # router-level records, asserted separately below
         assert r["unit"] == "tokens/s"
         assert np.isfinite(r["value"]) and r["value"] > 0
@@ -285,6 +288,29 @@ def test_bench_serving_records_schema(monkeypatch):
     assert pm["vit"]["vectors_per_s"] > 0
     assert pm["vit"]["embedding_dim"] > 0
     assert pm["vit"]["ttft_ms_p95"] >= pm["vit"]["ttft_ms_p50"] > 0
+    # the per-tenant QoS record (docs/SERVING.md "Per-tenant QoS &
+    # autoscaling"): at 2× measured saturation with a flooding tenant,
+    # DRR's well-behaved goodput strictly beats FIFO's on the SAME
+    # seeded trace, the well-behaved streams are byte-identical to the
+    # uncontended run, and the closed-loop autoscale sub-pass proves the
+    # pre-warmed newcomer prefix-hit on its first segment
+    assert qos["unit"] == "goodput_frac"
+    d = qos["detail"]
+    assert qos["value"] == d["goodput_well_drr"]
+    assert d["saturation_x"] == 2.0 and d["capacity_rps"] > 0
+    assert d["goodput_well_drr"] > d["goodput_well_fifo"]
+    assert d["parity_well_behaved"] is True
+    assert d["ttft_ms_p99_well_drr"] < d["ttft_ms_p99_well_fifo"]
+    assert d["preempted"] >= 0
+    assert len(d["workload_hash"]) == 16
+    assert set(d["per_tenant"]) == {"paid", "free", "flood"}
+    for t in ("paid", "free"):
+        assert d["per_tenant"][t]["drr_ttft_ms_p99"] > 0
+    asc = d["autoscale"]
+    assert asc["scale_ups"] >= 1
+    assert asc["new_replica_prefix_hits"] > 0
+    assert asc["prewarmed_tokens"] > 0
+    assert asc["segment2_completed"] == asc["segment2_requests"]
 
 
 def test_bench_serving_http_record_schema(monkeypatch):
@@ -315,6 +341,34 @@ def test_bench_serving_http_record_schema(monkeypatch):
     # HTTP/RPC serving tax
     assert np.isfinite(d["inproc_tokens_per_s"]) and d["inproc_tokens_per_s"] > 0
     assert d["inproc_ttft_ms_p50"] > 0 and d["inproc_elapsed_s"] > 0
+
+
+@pytest.mark.slow  # real sockets + threads + two replica servers (~30s);
+# the DRR/preemption/tenant contracts stay tier-1 via test_router_qos.py,
+# the tenant header -> submit(tenant=) seam via
+# test_api.py's tenant tests, and the bench record envelope via
+# test_bench_serving_http_record_schema above
+def test_bench_http_qos_record_schema(monkeypatch):
+    """The --http multi-tenant QoS record (ISSUE 19 satellite): the same
+    seeded bursty multi-tenant trace replayed over real RPC replicas +
+    DRR router + the OpenAI SSE API with the X-Fleetx-Tenant header
+    banks ``gpt_345m_serving_router_qos_http`` — well-behaved byte
+    parity vs the in-process DRR replay asserted inside, shed confined
+    to the flooding tenant, and the tenant label live on the scrape."""
+    monkeypatch.setenv("BENCH_SERVING_TINY", "1")
+    sys.path.insert(0, REPO)
+    import tools.bench_serving as bs
+
+    bs = importlib.reload(bs)
+    rec = bs.http_qos_record(slots=2, replicas=2)
+    assert rec["metric"] == "gpt_345m_serving_router_qos_http"
+    assert rec["unit"] == "goodput_frac"
+    assert 0 < rec["value"] <= 1
+    d = rec["detail"]
+    assert d["parity_well_behaved"] is True
+    assert set(d["shed_tenants"]) <= {"flood"}
+    assert d["api_tenant_labels"] is True
+    assert len(d["workload_hash"]) == 16
 
 
 @pytest.mark.slow  # 18.3s (PR 18 tier-1 budget audit): the timing is
@@ -512,6 +566,25 @@ def test_chaos_check_router_scenarios(tmp_path, capsys):
     assert rc == 0, out
     assert "PASS router_kill" in out
     assert "PASS router_saturation" in out
+
+
+@pytest.mark.slow  # ~20s; tier-1 covers the same contracts via
+def test_chaos_check_serving_qos_scenario(tmp_path, capsys):
+    # tests/test_router_qos.py (preemption byte parity, churn
+    # conservation under kill, lane-scoped shed); this proves the CLI
+    # scenario end-to-end
+    """The per-tenant QoS chaos scenario (flooding tenant saturates the
+    fleet, priority tenant preempts in, replica SIGKILLed mid-preemption
+    churn — priority AND preempted-flood streams byte-identical to a
+    clean engine, shed confined to the flood lane) passes through the
+    CLI driver."""
+    sys.path.insert(0, REPO)
+    import tools.chaos_check as cc
+
+    rc = cc.main(["--only", "serving_qos", "--workdir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "PASS serving_qos" in out
 
 
 def test_obs_dump_scrapes_live_server(tmp_path):

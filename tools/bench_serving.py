@@ -422,6 +422,346 @@ def _router_slo_report(model, variables, gen_cfg, slots):
     }
 
 
+def _qos_autoscale_subpass(model, variables, gen_cfg, slots):
+    """The closed-loop scale-up leg of the router_qos record: segment 1
+    (a shared-template trace) warms ONE replica's prefix trie and pool
+    pressure spills the template to the fleet's shared DiskPageStore;
+    segment 2 floods the single replica, the FleetAutoscaler spawns a
+    second engine on the same store and pre-warms it from
+    ``router.hot_prefixes()`` BEFORE it takes traffic — asserted: the
+    scale-up happened and the new replica prefix-HIT on its first trace
+    segment (non-zero ``prefix_hits``), i.e. the pre-warm was real."""
+    import shutil
+    import tempfile
+
+    from fleetx_tpu.obs import get_event_log
+    from fleetx_tpu.serving import (
+        FleetAutoscaler,
+        ServingEngine,
+        ServingRouter,
+        TenantSpec,
+        WorkloadSpec,
+        generate_trace,
+        run_trace,
+    )
+
+    page = 8 if _TINY else 16
+    prefix_len = 2 * page if _TINY else 4 * page
+    plo, phi = (prefix_len + 1, prefix_len + 2) if _TINY else (
+        prefix_len + 1, prefix_len + 32)
+    gen_rng = (3, 4) if _TINY else (8, 16)
+    pages_a = 8 if _TINY else 16        # tight: filler traffic must evict
+    pages_b = 24 if _TINY else 64
+    d = tempfile.mkdtemp(prefix="fleetx-qos-scale-")
+    try:
+        def mk(num_pages):
+            # small max_queue matters: an unbounded engine queue would
+            # swallow every affinity-pinned dispatch on replica 0, so the
+            # pre-warmed newcomer would never see template traffic —
+            # QueueFull overflow is what routes work onto it
+            return ServingEngine(
+                model, variables, slots=slots,
+                cache_len=model.cfg.max_position_embeddings,
+                gen_cfg=gen_cfg, page_size=page, num_pages=num_pages,
+                disk_cache_dir=d, disk_cache_bytes=1 << 22,
+                max_queue=2, prefill_bucket=8 if _TINY else 32)
+
+        def seg_spec(seed_unused, n, rate):
+            # one seed for BOTH segments: generate_trace draws shared
+            # prefixes first, so the template bytes are identical
+            return WorkloadSpec(
+                seed=31, n_requests=n, arrival_rate=rate,
+                vocab=model.cfg.vocab_size,
+                tenants=(TenantSpec("template", prompt_len=(plo, phi),
+                                    gen_len=gen_rng,
+                                    shared_prefix_len=prefix_len),))
+
+        eng_a = mk(pages_a)
+        router = ServingRouter([eng_a], probe_every=1)
+        seg1 = generate_trace(seg_spec(0, 4 if _TINY else 8, 1000.0))
+        run_trace(router, seg1)  # warms trie + router hot-prefix ledger
+        # deterministic pool pressure: distinct prompts evict the parked
+        # template pages, spilling them to the shared disk store
+        vocab = model.cfg.vocab_size
+        flen = phi
+        for base in (3, 5):
+            p = ((np.arange(flen, dtype=np.int64) * base + base)
+                 % (vocab - 1) + 1).astype(np.int32)
+            eng_a.submit(p, max_length=gen_rng[0])
+        eng_a.drain(max_ticks=2000)
+
+        spawned = []
+
+        def spawn():
+            e = mk(pages_b)
+            spawned.append(e)
+            return e
+
+        scaler = FleetAutoscaler(
+            router, spawn, min_replicas=1, max_replicas=2,
+            high_queue_tokens=2.0, low_queue_tokens=0.5,
+            eval_every=1, up_after=2, down_after=10 ** 6, prewarm=True)
+
+        class _Scaled:
+            # run_trace drives step(); the scaler rides every tick
+            def submit(self, prompt, **kw):
+                return router.submit(prompt, **kw)
+
+            def step(self):
+                router.step()
+                scaler.step()
+
+            def cancel(self, rid):
+                return router.cancel(rid)
+
+            def take_result(self, rid):
+                return router.take_result(rid)
+
+        seg2 = generate_trace(seg_spec(0, 12 if _TINY else 24, 1000.0))
+        outcomes = run_trace(_Scaled(), seg2)
+        assert scaler.scale_ups >= 1, "flooded replica never scaled up"
+        assert spawned, "scale-up reported but nothing spawned"
+        new_hits = int(spawned[0].metrics.prefix_hits)
+        assert new_hits > 0, (
+            "pre-warmed replica never prefix-hit on its first segment — "
+            "the DiskPageStore pre-warm did not take")
+        completed = sum(o.finish_reason in ("eos", "max_length")
+                        for o in outcomes)
+        assert completed == len(seg2), (
+            f"scale-up segment lost requests: {completed}/{len(seg2)}")
+        ups = get_event_log().find("autoscale_up")
+        prewarmed = int(ups[-1].attrs.get("prewarmed_tokens", 0)) if ups \
+            else 0
+        return {
+            "scale_ups": int(scaler.scale_ups),
+            "prewarmed_tokens": prewarmed,
+            "new_replica_prefix_hits": new_hits,
+            "segment1_requests": len(seg1),
+            "segment2_requests": len(seg2),
+            "segment2_completed": completed,
+            "shared_prefix_len": prefix_len,
+            "page_size": page,
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _router_qos_report(model, variables, gen_cfg, slots):
+    """The per-tenant QoS record (docs/SERVING.md "Per-tenant QoS &
+    autoscaling"): ONE seeded heavy-tailed (azure_llm) trace at 2× the
+    fleet's measured saturation throughput, two thirds of it a flooding
+    tenant, replayed twice over the same warmed replicas — once with
+    FIFO dispatch, once with DRR lanes + priority preemption. The gates:
+    the well-behaved tenants' TTFT p99 under DRR is strictly below
+    FIFO's on the SAME trace, their goodput at the derived SLO is
+    strictly above, and their token streams are byte-identical to an
+    UNCONTENDED replay (the flood never changed a byte — zero-loss
+    preemption included). ``detail.autoscale`` banks the closed-loop
+    scale-up + DiskPageStore pre-warm leg."""
+    import jax
+
+    from fleetx_tpu.serving import (
+        ServingEngine,
+        ServingRouter,
+        TenantPolicy,
+        TenantSpec,
+        WorkloadSpec,
+        generate_trace,
+        run_trace,
+        score_goodput,
+        trace_hash,
+    )
+
+    n_replicas = 2
+    n_well = 8 if _TINY else 24
+    n_total = 3 * n_well
+    prompt_rng = (3, 8) if _TINY else (32, 128)
+    gen_rng = (3, 6) if _TINY else (16, 64)
+    well = ("paid", "free")
+
+    def tenant_specs(with_flood):
+        out = [
+            TenantSpec("paid", weight=1.0, prompt_len=prompt_rng,
+                       gen_len=gen_rng),
+            TenantSpec("free", weight=1.0, prompt_len=prompt_rng,
+                       gen_len=gen_rng),
+        ]
+        if with_flood:
+            out.append(TenantSpec("flood", weight=4.0,
+                                  prompt_len=prompt_rng, gen_len=gen_rng))
+        return tuple(out)
+
+    # the tenant contracts: paid outranks (and may preempt), the flood
+    # lane is bounded so its backlog sheds onto ITSELF (lane-scoped
+    # QueueFull), never onto the well-behaved lanes
+    policies = {
+        "paid": TenantPolicy(weight=4.0, priority=1),
+        "free": TenantPolicy(weight=2.0),
+        "flood": TenantPolicy(weight=1.0, max_queue=max(4, slots)),
+    }
+
+    replicas = [
+        ServingEngine(model, variables, slots=slots,
+                      cache_len=model.cfg.max_position_embeddings,
+                      gen_cfg=gen_cfg, prefill_bucket=8 if _TINY else 32)
+        for _ in range(n_replicas)
+    ]
+
+    def mk_router(mode):
+        return ServingRouter(replicas, tenants=policies, dispatch=mode,
+                             preempt=(mode == "drr"), preempt_risk_frac=0.0)
+
+    class _Target:
+        """submit shim: paid requests carry a (generous) deadline —
+        what arms the deadline-at-risk preemption path."""
+
+        supports_tenants = True
+
+        def __init__(self, r):
+            self.r = r
+
+        def submit(self, prompt, *, tenant=None, **kw):
+            if tenant == "paid":
+                kw["deadline_s"] = 120.0
+            return self.r.submit(prompt, tenant=tenant, **kw)
+
+        def step(self):
+            self.r.step()
+
+        def cancel(self, rid):
+            return self.r.cancel(rid)
+
+        def take_result(self, rid):
+            return self.r.take_result(rid)
+
+    # ---- calibrate saturation: near-simultaneous arrivals => elapsed is
+    # pure service time and n/elapsed is the fleet's throughput ceiling
+    calib_spec = WorkloadSpec(
+        seed=23, n_requests=n_well, arrival_rate=1000.0,
+        vocab=model.cfg.vocab_size, tenants=tenant_specs(False),
+        distribution="azure_llm")
+    calib = generate_trace(calib_spec)
+    run_trace(_Target(mk_router("drr")), calib)  # compile warmup
+    t0 = time.perf_counter()
+    run_trace(_Target(mk_router("drr")), calib)
+    capacity_rps = n_well / (time.perf_counter() - t0)
+
+    # ---- the contended trace: heavy-tailed arrivals at 2× saturation,
+    # flood weighted to ~2/3 of them — the misbehaving-tenant shape
+    spec = WorkloadSpec(
+        seed=29, n_requests=n_total, arrival_rate=2.0 * capacity_rps,
+        vocab=model.cfg.vocab_size, tenants=tenant_specs(True),
+        distribution="azure_llm")
+    trace = generate_trace(spec)
+    well_trace = [r for r in trace if r.tenant in well]
+    assert len(well_trace) >= max(4, n_well // 2), (
+        f"seeded mix starved the well-behaved tenants: {len(well_trace)}")
+
+    # uncontended reference: the SAME well-behaved requests (same bytes,
+    # same arrival offsets) with the flood deleted — the parity source
+    unc = run_trace(_Target(mk_router("drr")), well_trace,
+                    keep_tokens=True)
+
+    fifo = run_trace(_Target(mk_router("fifo")), trace)
+    drr_router = mk_router("drr")
+    drr = run_trace(_Target(drr_router), trace, keep_tokens=True)
+
+    def well_of(outcomes):
+        return [o for o in outcomes if o.tenant in well]
+
+    # byte parity: every well-behaved stream under DRR+flood+preemption
+    # is identical to its uncontended run (and all of them completed)
+    unc_by_idx = {o.index: o for o in unc}
+    for o in well_of(drr):
+        ref = unc_by_idx[o.index]
+        assert o.finish_reason in ("eos", "max_length"), (
+            f"DRR shed well-behaved request {o.index}: {o.finish_reason}")
+        assert ref.finish_reason in ("eos", "max_length"), (
+            f"uncontended run shed request {o.index}: {ref.finish_reason}")
+        assert o.tokens == ref.tokens, (
+            f"request {o.index} ({o.tenant}) diverged under contention")
+
+    # latency isolation, the raw perf claim: DRR keeps the well-behaved
+    # TTFT tail below FIFO's on the same trace
+    def ttft_p99_ms(outcomes):
+        return _pct_ms([o.ttft_s for o in outcomes], 99)
+
+    def _pct_ms(vals, q):
+        vals = [v * 1e3 for v in vals if v is not None]
+        return float(np.percentile(np.asarray(vals, np.float64), q)) \
+            if vals else None
+
+    fifo_p99 = ttft_p99_ms(well_of(fifo))
+    drr_p99 = ttft_p99_ms(well_of(drr))
+    unc_p99 = ttft_p99_ms(well_of(unc))
+    assert fifo_p99 is not None and drr_p99 is not None
+    assert drr_p99 < fifo_p99, (
+        f"DRR did not isolate the well-behaved tail: DRR p99 {drr_p99:.1f}"
+        f"ms >= FIFO p99 {fifo_p99:.1f}ms")
+
+    # goodput at a derived SLO between the two tails: the threshold a
+    # well-behaved user could actually be sold given this fleet
+    ttft_dl_s = float(np.sqrt(drr_p99 * fifo_p99)) / 1e3
+
+    def rescore(outcomes):
+        for o in outcomes:
+            if o.tenant in well:
+                o.ttft_deadline_s = ttft_dl_s
+        return score_goodput(outcomes)
+
+    def well_goodput(outcomes):
+        ws = well_of(outcomes)
+        return round(sum(o.good for o in ws) / len(ws), 4)
+
+    fifo_score, drr_score = rescore(fifo), rescore(drr)
+    unc_score = rescore(unc)
+    gw_fifo, gw_drr = well_goodput(fifo), well_goodput(drr)
+    assert gw_drr > gw_fifo, (
+        f"DRR goodput not above FIFO at the derived SLO: "
+        f"{gw_drr} <= {gw_fifo}")
+
+    drr_snap = drr_router.metrics.snapshot()
+    per_tenant = {}
+    for t in ("paid", "free", "flood"):
+        per_tenant[t] = {
+            "fifo_ttft_ms_p99": _pct_ms(
+                [o.ttft_s for o in fifo if o.tenant == t], 99),
+            "drr_ttft_ms_p99": _pct_ms(
+                [o.ttft_s for o in drr if o.tenant == t], 99),
+            "drr_tpot_ms_p99": _pct_ms(
+                [o.tpot_ms / 1e3 for o in drr
+                 if o.tenant == t and o.tpot_ms is not None], 99),
+        }
+
+    return {
+        "requests": n_total,
+        "well_requests": len(well_trace),
+        "n_replicas": n_replicas,
+        "replica_slots": slots,
+        "distribution": spec.distribution,
+        "capacity_rps": round(capacity_rps, 2),
+        "arrival_rate": round(spec.arrival_rate, 2),
+        "saturation_x": 2.0,
+        "workload_hash": trace_hash(trace),
+        "ttft_deadline_ms": round(ttft_dl_s * 1e3, 1),
+        "goodput_well_fifo": gw_fifo,
+        "goodput_well_drr": gw_drr,
+        "ttft_ms_p99_well_fifo": round(fifo_p99, 1),
+        "ttft_ms_p99_well_drr": round(drr_p99, 1),
+        "ttft_ms_p99_well_uncontended": (
+            round(unc_p99, 1) if unc_p99 is not None else None),
+        "preempted": drr_snap["preempted"],
+        "parity_well_behaved": True,  # asserted above
+        "per_tenant": per_tenant,
+        "fifo": fifo_score,
+        "drr": drr_score,
+        "uncontended": unc_score,
+        "autoscale": _qos_autoscale_subpass(model, variables, gen_cfg,
+                                            slots),
+        "device": getattr(jax.devices()[0], "device_kind", "?"),
+    }
+
+
 def _hetero_report(model, variables, gen_cfg, slots, workload, ref_toks):
     """The heterogeneous-fleet record (docs/SERVING.md "Heterogeneous
     fleet"): the continuous GPT workload plus an equal embedding
@@ -1224,6 +1564,19 @@ def serving_records(n_requests: int = N_REQUESTS, slots: int = SLOTS):
         "vs_baseline": None,
         "detail": hetero_detail,
     })
+
+    # per-tenant QoS record (docs/SERVING.md "Per-tenant QoS &
+    # autoscaling"): DRR vs FIFO goodput for the well-behaved tenants at
+    # 2× saturation with one flooding tenant — byte parity vs an
+    # uncontended replay and the autoscale pre-warm leg asserted inside
+    qos_detail = _router_qos_report(model, variables, gen_cfg, slots)
+    records.append({
+        "metric": "gpt_345m_serving_router_qos",
+        "value": qos_detail["goodput_well_drr"],
+        "unit": "goodput_frac",
+        "vs_baseline": None,
+        "detail": qos_detail,
+    })
     return records
 
 
@@ -1348,6 +1701,203 @@ def http_record(n_requests: int = N_REQUESTS, slots: int = SLOTS,
     }
 
 
+def http_qos_record(slots: int = SLOTS, replicas: int = 2):
+    """The ``gpt_345m_serving_router_qos_http`` record: the same
+    multi-tenant bursty (azure_llm) trace the in-process QoS record
+    uses, replayed through the deployable front door — replica RPC
+    servers, a DRR router over :class:`ReplicaClient` proxies, and the
+    OpenAI API forwarding each request's ``X-Fleetx-Tenant`` header into
+    ``submit(tenant=...)``. Asserted: every well-behaved stream over
+    HTTP is byte-identical to the in-process DRR replay of the same
+    trace (tenant threading survives the wire), all well-behaved
+    requests complete on both sides, any shed lands on the flood lane
+    alone (its bounded lane → HTTP 429), and the scraped
+    ``fleetx_api_*`` families carry the tenant label end-to-end."""
+    import concurrent.futures
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    from fleetx_tpu.models.gpt.generation import GenerationConfig
+    from fleetx_tpu.obs import get_registry
+    from fleetx_tpu.serving import (
+        RequestOutcome,
+        ServingEngine,
+        ServingRouter,
+        TenantPolicy,
+        TenantSpec,
+        WorkloadSpec,
+        generate_trace,
+        run_trace,
+        score_goodput,
+        trace_hash,
+    )
+    from fleetx_tpu.serving.api.replica_client import ReplicaClient
+    from fleetx_tpu.serving.api.replica_server import ReplicaServer
+    from fleetx_tpu.serving.api.server import ApiServer
+
+    model = _model()
+    variables = jax.jit(model.init)(
+        jax.random.PRNGKey(0),
+        np.zeros((1, PROMPT_RANGE[1]), np.int32),
+    )
+    gen_cfg = GenerationConfig(decode_strategy="greedy", eos_token_id=-1,
+                               pad_token_id=0, max_length=GEN_RANGE[1])
+
+    n_well = 6 if _TINY else 16
+    n_total = 2 * n_well
+    prompt_rng = (3, 8) if _TINY else (32, 96)
+    gen_rng = (3, 6) if _TINY else (8, 32)
+    rate = 50.0 if _TINY else 20.0
+    well = ("paid", "free")
+    policies = {
+        "paid": TenantPolicy(weight=4.0, priority=1, preempt=False),
+        "free": TenantPolicy(weight=2.0),
+        "flood": TenantPolicy(weight=1.0, max_queue=2),
+    }
+    spec = WorkloadSpec(
+        seed=37, n_requests=n_total, arrival_rate=rate,
+        vocab=model.cfg.vocab_size, distribution="azure_llm",
+        tenants=(
+            TenantSpec("paid", weight=1.0, prompt_len=prompt_rng,
+                       gen_len=gen_rng),
+            TenantSpec("free", weight=1.0, prompt_len=prompt_rng,
+                       gen_len=gen_rng),
+            TenantSpec("flood", weight=2.0, prompt_len=prompt_rng,
+                       gen_len=gen_rng),
+        ))
+    trace = generate_trace(spec)
+
+    def make_engine():
+        return ServingEngine(model, variables, slots=slots,
+                             cache_len=model.cfg.max_position_embeddings,
+                             gen_cfg=gen_cfg,
+                             prefill_bucket=8 if _TINY else 32)
+
+    # in-process DRR reference on its own engines: the parity source
+    ref_engines = [make_engine() for _ in range(replicas)]
+
+    def ref_router():
+        return ServingRouter(ref_engines, tenants=policies,
+                             dispatch="drr", preempt=False)
+
+    run_trace(ref_router(), trace)  # compile warmup
+    ref = run_trace(ref_router(), trace, keep_tokens=True)
+    ref_by_idx = {o.index: o for o in ref}
+
+    servers = [ReplicaServer(make_engine()).start() for _ in range(replicas)]
+    api = None
+    try:
+        clients = [ReplicaClient(s.url, connect_wait_s=10) for s in servers]
+        api = ApiServer(ServingRouter(clients, tenants=policies,
+                                      dispatch="drr", preempt=False),
+                        model_id="fleetx-qos").start()
+
+        def one(tr, t0):
+            delay = tr.arrival_s - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            req = urllib.request.Request(
+                api.url + "/v1/completions",
+                json.dumps({"prompt": [int(t) for t in tr.prompt],
+                            "max_tokens": int(tr.max_new_tokens),
+                            "stream": True}).encode(),
+                {"Content-Type": "application/json",
+                 "X-Fleetx-Tenant": tr.tenant})
+            t_submit = time.perf_counter()
+            times, toks = [], []
+            try:
+                with urllib.request.urlopen(req, timeout=600) as resp:
+                    for line in resp:
+                        line = line.decode().strip()
+                        if (not line.startswith("data: ")
+                                or line[6:] == "[DONE]"):
+                            continue
+                        chunk = json.loads(line[6:])
+                        if "token" in chunk:
+                            times.append(time.perf_counter())
+                            toks.append(int(chunk["token"]))
+            except urllib.error.HTTPError as e:
+                e.read()
+                return RequestOutcome(index=tr.index, tenant=tr.tenant,
+                                      finish_reason="rejected"), None
+            done = len(toks) == tr.max_new_tokens
+            tpot = ((times[-1] - times[0]) / (len(times) - 1) * 1e3
+                    if len(times) >= 2 else None)
+            return RequestOutcome(
+                index=tr.index, tenant=tr.tenant,
+                finish_reason="max_length" if done else "error",
+                n_tokens=len(toks),
+                ttft_s=(times[0] - t_submit) if times else None,
+                tpot_ms=tpot), tuple(toks)
+
+        def sweep():
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=len(trace)) as pool:
+                return list(pool.map(lambda tr: one(tr, t0), trace))
+
+        sweep()  # warmup: compiles every replica engine's decode path
+        t0 = time.perf_counter()
+        results = sweep()
+        elapsed = time.perf_counter() - t0
+    finally:
+        if api is not None:
+            api.stop()
+        for s in servers:
+            s.stop()
+
+    http_outcomes = [o for o, _ in results]
+    toks_by_idx = {o.index: t for o, t in results}
+    for o in http_outcomes:
+        if o.tenant not in well:
+            continue
+        assert o.finish_reason == "max_length", (
+            f"well-behaved request {o.index} did not complete over "
+            f"HTTP: {o.finish_reason}")
+        ro = ref_by_idx[o.index]
+        assert ro.finish_reason in ("eos", "max_length"), (
+            f"in-process reference shed request {o.index}")
+        assert toks_by_idx[o.index] == ro.tokens, (
+            f"request {o.index} ({o.tenant}) diverged between HTTP "
+            f"and in-process")
+    shed = [o for o in http_outcomes if o.finish_reason == "rejected"]
+    assert all(o.tenant == "flood" for o in shed), (
+        "shed leaked outside the flood lane: "
+        f"{sorted({o.tenant for o in shed})}")
+    scrape = get_registry().prometheus_text()
+    tenant_labeled = ('tenant="flood"' in scrape
+                      and 'tenant="paid"' in scrape)
+    assert tenant_labeled, "fleetx_api_* families lost the tenant label"
+
+    http_score = score_goodput(http_outcomes)
+    ref_score = score_goodput(ref)
+    well_http = [o for o in http_outcomes if o.tenant in well]
+    value = round(sum(o.good for o in well_http) / len(well_http), 4)
+    return {
+        "metric": "gpt_345m_serving_router_qos_http",
+        "value": value,
+        "unit": "goodput_frac",
+        "vs_baseline": None,
+        "detail": {
+            "requests": n_total,
+            "replicas": replicas,
+            "slots": slots,
+            "arrival_rate": rate,
+            "distribution": spec.distribution,
+            "workload_hash": trace_hash(trace),
+            "elapsed_s": round(elapsed, 3),
+            "parity_well_behaved": True,  # asserted above
+            "shed_tenants": sorted({o.tenant for o in shed}),
+            "api_tenant_labels": tenant_labeled,
+            "http": http_score,
+            "inproc": ref_score,
+            "device": getattr(jax.devices()[0], "device_kind", "?"),
+        },
+    }
+
+
 if __name__ == "__main__":
     from fleetx_tpu.utils.device_guard import acquire_devices_or_die
 
@@ -1359,6 +1909,7 @@ if __name__ == "__main__":
     )
     if "--http" in sys.argv[1:]:
         print(json.dumps(http_record()))
+        print(json.dumps(http_qos_record()))
     else:
         for rec in serving_records():
             print(json.dumps(rec))

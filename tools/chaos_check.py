@@ -1098,6 +1098,97 @@ def scenario_serving_hetero(tmp):
             f"({len(seen['gpt'])} gpt / {len(seen['vit'])} vit submits)")
 
 
+def scenario_serving_qos(tmp):
+    """Per-tenant QoS under fire: a flooding tenant saturates the fleet,
+    a priority tenant preempts its way in, and a replica is SIGKILLed
+    right in the middle of the preemption churn — the priority tenant's
+    streams stay byte-identical to a clean uncontended engine, every
+    preempted flood request still finishes byte-identically (zero-loss
+    preemption across the kill), and all shed stays on the flood lane."""
+    import numpy as np
+
+    from fleetx_tpu.obs import get_event_log
+    from fleetx_tpu.resilience.faults import faults
+    from fleetx_tpu.serving import QueueFull, ServingRouter, TenantPolicy
+
+    make, prompts = _serving_fixture()
+    flood_prompts = [np.asarray([20 + j, 25, 30 + j], np.int32)
+                     for j in range(16)]
+    # clean references from a lone uncontended engine: greedy decode is
+    # batch-composition-invariant, so these are THE bytes every tenant
+    # must reproduce through preemption, migration, and the kill
+    clean_paid, _, _ = _run_workload(make(True), prompts)
+    flood_ref = {}
+    ref = make(True)
+    for j, p in enumerate(flood_prompts):
+        flood_ref[j] = ref.submit(p, max_length=16)
+    ref_res = ref.drain()
+    clean_flood = {j: np.asarray(ref_res[r].tokens)
+                   for j, r in flood_ref.items()}
+
+    faults.configure(replica_kill="1:6")
+    try:
+        router = ServingRouter(
+            [make(True, max_queue=1) for _ in range(2)],
+            tenants={"paid": TenantPolicy(weight=4.0, priority=1),
+                     "flood": TenantPolicy(weight=1.0, max_queue=4)},
+            probe_every=1, preempt_risk_frac=0.0)
+        # flood in rounds so dispatch keeps both replicas' slots AND
+        # engine queues pinned full while the lane holds a backlog —
+        # long generations (16 tokens) keep them busy past the kill
+        flood_rids, rejected = {}, 0
+        fi = iter(range(len(flood_prompts)))
+        for _ in range(4):
+            for j in (next(fi), next(fi), next(fi), next(fi)):
+                try:
+                    flood_rids[j] = router.submit(
+                        flood_prompts[j], max_length=16, tenant="flood")
+                except QueueFull:
+                    rejected += 1
+            router.step()
+        # the priority tenant arrives into a saturated fleet: a generous
+        # total deadline arms preemption (risk_frac=0.0 -> any capacity
+        # refusal preempts a lower-priority victim) without shed risk
+        paid_rids = [router.submit(p, max_length=8, tenant="paid",
+                                   deadline_s=120.0) for p in prompts]
+        res = router.drain(max_ticks=500)
+    finally:
+        faults.reset()
+    accepted = len(flood_rids) + len(paid_rids)
+    assert len(res) == accepted, (
+        f"{accepted} accepted, {len(res)} terminal results — requests "
+        "were lost or duplicated")
+    assert rejected > 0, "the flood never overflowed its bounded lane"
+    for i, rid in enumerate(paid_rids):
+        assert res[rid].finish_reason in ("eos", "max_length"), (
+            f"priority request {rid} shed under flood: "
+            f"{res[rid].finish_reason}")
+        assert np.array_equal(np.asarray(res[rid].tokens), clean_paid[i]), (
+            f"priority request {rid} diverged from the clean "
+            "uncontended engine")
+    for j, rid in flood_rids.items():
+        assert np.array_equal(np.asarray(res[rid].tokens),
+                              clean_flood[j]), (
+            f"flood request {rid} diverged after preemption/kill — "
+            "preemption lost or duplicated tokens")
+    ev = get_event_log()
+    preempted = ev.find("request_preempted")
+    assert preempted, "saturated fleet + priority deadline never preempted"
+    assert all(e.attrs["tenant"] == "flood" for e in preempted), (
+        "a non-flood request was preempted: "
+        f"{[e.attrs for e in preempted]}")
+    assert ev.find("replica_dead", replica=1), "the kill never landed"
+    assert ev.find("fault_injected", fault="replica_kill")
+    assert ev.find("request_migrated"), "no request_migrated event"
+    m = router.metrics.snapshot()
+    assert m["preempted"] >= 1 and m["replica_deaths"] == 1, m
+    return (f"flood saturated 2 replicas ({rejected} lane rejects); "
+            f"{len(preempted)} preemption(s), replica 1 SIGKILLed at "
+            f"tick 6 mid-churn; {len(paid_rids)}/{len(paid_rids)} "
+            f"priority + {len(flood_rids)}/{len(flood_rids)} flood "
+            "streams byte-identical, shed confined to the flood lane")
+
+
 SCENARIOS = {
     "sentry": scenario_sentry,
     "sentry_zero": scenario_sentry_zero,
@@ -1115,6 +1206,7 @@ SCENARIOS = {
     "serving_disagg": scenario_serving_disagg,
     "serving_http": scenario_serving_http,
     "serving_hetero": scenario_serving_hetero,
+    "serving_qos": scenario_serving_qos,
 }
 
 

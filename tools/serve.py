@@ -139,6 +139,40 @@ def _spawn_replicas(n: int, grace_s: float, tmpdir: str):
     return procs, urls
 
 
+def spawn_replica(tmpdir: str, index: int, grace_s: float = 30.0,
+                  connect_wait_s: float = 30.0):
+    """Launch ONE additional replica subprocess and hand back a
+    connected ``ReplicaClient`` plus its process — the autoscaler's
+    ``spawn_fn`` seam (``--autoscale``; docs/SERVING.md "Per-tenant QoS
+    & autoscaling"). Same worker entry + port-file handshake as the
+    launch-time fleet, so a scale-up replica is indistinguishable from
+    a launch-time one. Raises on launch failure (the caller decides
+    whether that aborts or just skips this scale-up)."""
+    from fleetx_tpu.serving.api.replica_client import ReplicaClient
+
+    pf = os.path.join(tmpdir, f"replica_{index}.port")
+    if os.path.exists(pf):
+        os.remove(pf)  # a reused index must not read a stale port
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--replica-worker", "--device-index", str(index),
+         "--port-file", pf, "--grace-s", str(grace_s)],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    deadline = time.monotonic() + 120
+    while not os.path.exists(pf):
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"replica {index} exited rc={proc.returncode} "
+                "before publishing its port")
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise RuntimeError(f"replica {index} never published its port")
+        time.sleep(0.05)
+    with open(pf) as f:
+        url = f"http://127.0.0.1:{int(f.read().strip())}"
+    return ReplicaClient(url, connect_wait_s=connect_wait_s), proc
+
+
 def run_fleet(args) -> int:
     """Parent entry: replicas → router-over-RPC → API, then serve until
     SIGTERM and drain the whole fleet."""
@@ -161,6 +195,26 @@ def run_fleet(args) -> int:
         try:
             clients = [ReplicaClient(u, connect_wait_s=30) for u in urls]
             router = ServingRouter(clients)
+            scaler = None
+            if args.autoscale:
+                from fleetx_tpu.serving.autoscaler import FleetAutoscaler
+
+                next_index = [replicas]
+
+                def spawn():
+                    try:
+                        client, proc = spawn_replica(
+                            tmpdir, next_index[0], grace_s)
+                    except Exception as e:  # noqa: BLE001 — skip this round
+                        logger.error("serve: scale-up spawn failed: %s", e)
+                        return None
+                    procs.append(proc)
+                    next_index[0] += 1
+                    return client
+
+                scaler = FleetAutoscaler(router, spawn,
+                                         min_replicas=replicas,
+                                         grace_s=grace_s)
             api = ApiServer(router, port=port, host=host,
                             model_id=args.model_id).start()
             if args.api_port_file:
@@ -184,6 +238,8 @@ def run_fleet(args) -> int:
                     logger.error("serve: every replica process exited; "
                                  "shutting the front door down")
                     break
+                if scaler is not None:
+                    scaler.step()
                 time.sleep(0.1)
 
             logger.info("serve: draining fleet (grace %.0fs)", grace_s)
@@ -222,6 +278,10 @@ def main(argv=None) -> int:
                     help="model id served at /v1/models")
     ap.add_argument("--grace-s", type=float, default=None,
                     help="drain grace (default $FLEETX_SERVE_GRACE_S or 30)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="close the fleet-sizing loop: a FleetAutoscaler "
+                         "watches replica health and spawns/drains replica "
+                         "processes (FLEETX_AUTOSCALE_* knobs)")
     ap.add_argument("--api-port-file", default=None,
                     help="write the bound API port here once serving "
                          "(handshake for tests/scripts)")
