@@ -235,6 +235,29 @@ def train_record(batch: int, *, seq: int, steps: int, warmup: int,
     if xla_mfu is not None:
         trainer._obs_mfu.set(xla_mfu)
         rec["detail"]["xla_mfu"] = round(xla_mfu, 4)
+    # checkpoint-cadence pricing (ISSUE 20): the step-path stall of one
+    # save under FLEETX_CKPT_ASYNC_SNAPSHOT is the D2H snapshot alone —
+    # time it (no disk write) so the cadence-vs-MFU trade is priced on
+    # every hardware window: stall fraction = snapshot_blocking_s /
+    # (save_steps * step_time_s)
+    try:
+        from fleetx_tpu.core.engine import _unbox
+        t_snap = time.perf_counter()
+        host_state = jax.device_get(_unbox(state))
+        snap_s = time.perf_counter() - t_snap
+        state_bytes = sum(getattr(l, "nbytes", 0)
+                         for l in jax.tree.leaves(host_state))
+        del host_state
+        rec["detail"]["ckpt"] = {
+            "snapshot_blocking_s": round(snap_s, 4),
+            "state_gb": round(state_bytes / 2**30, 3),
+            "save_steps_for_1pct_stall": round(snap_s / (dt / steps) * 100, 1),
+            "note": "blocking stall per save cadence under "
+                    "FLEETX_CKPT_ASYNC_SNAPSHOT (D2H copy only; upload "
+                    "is off the step path)",
+        }
+    except Exception:
+        pass
     # release the model/opt state before the next in-process bench run
     del state, trainer, module, db
     gc.collect()

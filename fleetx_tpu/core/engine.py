@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import threading
 import time
 from typing import Any, Dict, Iterable, Optional
 
@@ -38,6 +39,7 @@ from fleetx_tpu.parallel.mesh import DATA_AXES, MeshConfig, build_mesh, use_mesh
 from fleetx_tpu.parallel.sharding import (
     make_rules, param_shardings, zero_update_spec,
 )
+from fleetx_tpu.resilience.elastic import ElasticMeshMismatch, validate_restore_mesh
 from fleetx_tpu.resilience.faults import faults
 from fleetx_tpu.utils.hw import peak_flops_per_chip
 from fleetx_tpu.utils.log import logger
@@ -234,8 +236,18 @@ class Trainer:
         self._prev_sigterm = None
         self.state: Optional[TrainState] = None
         self.start_epoch = 0
+        self._cur_epoch = 0  # epoch the fit loop is currently inside
         self.consumed_samples = 0
         self._ckpt_mgr = None
+        # step-shadow snapshot checkpointing (FLEETX_CKPT_ASYNC_SNAPSHOT):
+        # save() copies state device->host in the step path and hands the
+        # host tree to a background uploader thread, so the step only stalls
+        # for the D2H copy. Single-process only: multi-host orbax saves are
+        # collective, and a per-host thread would skew the barrier.
+        self._ckpt_async = (
+            os.environ.get("FLEETX_CKPT_ASYNC_SNAPSHOT", "0") == "1"
+            and jax.process_count() == 1)
+        self._upload_thread = None  # in-flight snapshot uploader
 
         # step sentry (docs/RESILIENCE.md): finite/spike check folded into
         # the jitted train step; anomalous steps are skipped, not applied.
@@ -289,6 +301,15 @@ class Trainer:
             "fleetx_train_opt_state_bytes",
             "Optimizer-state bytes resident per device (ZeRO update "
             "sharding shrinks this by the dp*fsdp factor)")
+        self._obs_ckpt_seconds = reg.histogram(
+            "fleetx_ckpt_save_seconds",
+            "Checkpoint save duration; phase=blocking is the step-path "
+            "stall (D2H snapshot under FLEETX_CKPT_ASYNC_SNAPSHOT, the "
+            "whole write otherwise), phase=total includes the async upload",
+            labelnames=("phase",))
+        self._obs_ckpt_bytes = reg.gauge(
+            "fleetx_ckpt_bytes",
+            "Bytes of train state in the last checkpoint snapshot")
         # expose every instrument at zero immediately (matching the
         # serving metrics, whose children exist from __init__): a healthy
         # run must scrape as 0, not as absent-looking-like-broken
@@ -296,8 +317,11 @@ class Trainer:
                     self._obs_save_failures, self._obs_quarantines,
                     self._obs_loss, self._obs_lr, self._obs_step_time,
                     self._obs_tokens_per_s, self._obs_mfu,
-                    self._obs_hbm_bytes, self._obs_opt_bytes):
+                    self._obs_hbm_bytes, self._obs_opt_bytes,
+                    self._obs_ckpt_bytes):
             fam.labels()
+        for phase in ("blocking", "total"):
+            self._obs_ckpt_seconds.labels(phase=phase)
         self._flops_per_step = None  # lazy; False = cost analysis failed
         self._hbm_bytes_per_step = None  # same contract as _flops_per_step
         self._cost_cache = {}  # name -> (abstract-args spec, cost dict)
@@ -754,6 +778,7 @@ class Trainer:
     def _fit_epochs(self, train_data, valid_data, epochs, step,
                     tokens_per_batch, train_step):
         for epoch in range(self.start_epoch, epochs):
+            self._cur_epoch = epoch  # for emergency saves by outer supervisors
             sampler = getattr(train_data, "batch_sampler", None)
             if sampler is not None and hasattr(sampler, "set_epoch"):
                 sampler.set_epoch(epoch)
@@ -797,6 +822,12 @@ class Trainer:
                     self.save(epoch=epoch)
                     self.wait_for_checkpoints()
                     return
+                # elastic failure domain: a matching FLEETX_FAULT_HOST_LOSS
+                # plan raises HostLossFault here, BEFORE the step runs — the
+                # aborted step's batch was fetched but not applied, so the
+                # supervisor's resumed run re-feeds it exactly once
+                # (resilience/elastic.py has the recovery loop)
+                faults.on_train_step(step)
                 batch = self.module.pretreating_batch(batch)
                 if tokens_per_batch is None:
                     # ips accounting: LM batches carry "tokens", encoder/
@@ -1001,8 +1032,22 @@ class Trainer:
         return self._ckpt_mgr
 
     def wait_for_checkpoints(self):
+        self._join_uploader()
         if self._ckpt_mgr is not None:
             self._ckpt_mgr.wait_until_finished()
+
+    def _join_uploader(self):
+        """Block until the in-flight snapshot upload (if any) finishes."""
+        t = self._upload_thread
+        if t is not None:
+            t.join()
+            self._upload_thread = None
+
+    def _record_save_failure(self, step: int) -> None:
+        """Count + emit one failed checkpoint save (the run survives)."""
+        self.save_failures += 1
+        self._obs_save_failures.inc()
+        obs_emit("save_failure", step=step, failures=self.save_failures)
 
     def _guarded_save(self, epoch: int = 0):
         """Periodic/emergency save that survives a failed write: a full
@@ -1011,25 +1056,51 @@ class Trainer:
         try:
             self.save(epoch=epoch)
         except Exception:
-            self.save_failures += 1
-            self._obs_save_failures.inc()
-            obs_emit("save_failure", step=int(self.state.step),
-                     failures=self.save_failures)
+            self._record_save_failure(int(self.state.step))
             logger.exception(
                 "checkpoint save failed at step %d (%d failures so far); "
                 "training continues, next save in %d steps",
                 int(self.state.step), self.save_failures, self.save_steps,
             )
 
+    def _save_meta(self, epoch: int) -> dict:
+        """The JSON side of a checkpoint (resume + compatibility record)."""
+        return {
+            "epoch": epoch, "consumed_samples": self.consumed_samples,
+            # the dropout noise stream is defined by these two switches
+            # (ops/dropout.py HashDropout vs nn.Dropout; flash kernel hash
+            # vs hardware PRNG) — record them so a resume under flipped
+            # flags is detectable instead of silently changing the masks
+            "dropout_impl": self._dropout_impl(),
+            # the mesh this state was written under: dp/fsdp may change on
+            # restore (elastic reshard-on-load), mp/pp/cp must not — their
+            # extents are baked into array shapes (resilience/elastic.py)
+            "mesh": {"dp": self.mesh_cfg.dp, "fsdp": self.mesh_cfg.fsdp,
+                     "mp": self.mesh_cfg.mp, "pp": self.mesh_cfg.pp,
+                     "cp": self.mesh_cfg.cp},
+        }
+
     def save(self, epoch: int = 0):
         """Sharded save of {params, opt_state, step} + meta (epoch,
         consumed_samples) — reference meta_state.pdopt semantics
-        (eager_engine.py:655-665)."""
+        (eager_engine.py:655-665).
+
+        Under ``FLEETX_CKPT_ASYNC_SNAPSHOT`` (step-shadow snapshot
+        checkpointing) the step path blocks only for the device→host copy;
+        a background uploader thread feeds the host tree to the orbax
+        manager, and an upload failure rides the same counter/event path
+        as a synchronous one (``_guarded_save``). A meta-advanced rewrite
+        of an existing step detaches the old directory first and reattaches
+        it if the replacement save fails — a crash or injected fault in
+        the rewrite window can never destroy the only copy of a step."""
         import orbax.checkpoint as ocp
 
+        self._join_uploader()  # serialize with an in-flight snapshot upload
         mgr = self._ckpt_manager()
         step = int(self.state.step)
         meta_sig = (step, epoch, self.consumed_samples)
+        t0 = time.perf_counter()
+        backup = None
         if step in (mgr.all_steps() or []):
             if meta_sig == self._last_saved_meta:
                 # e.g. a preemption save landing right on a periodic-save
@@ -1045,25 +1116,155 @@ class Trainer:
                         "(consumed_samples %s); rewriting", step,
                         self.consumed_samples)
             mgr.wait_until_finished()
-            mgr.delete(step)
-        faults.on_checkpoint_save(step)  # chaos injection point (inert: no-op)
-        mgr.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(_unbox(self.state)),
-                meta=ocp.args.JsonSave(
-                    {"epoch": epoch, "consumed_samples": self.consumed_samples,
-                     # the dropout noise stream is defined by these two
-                     # switches (ops/dropout.py HashDropout vs nn.Dropout;
-                     # flash kernel hash vs hardware PRNG) — record them so
-                     # a resume under flipped flags is detectable instead of
-                     # silently changing the masks mid-run
-                     "dropout_impl": self._dropout_impl()}
+            backup = self._detach_step(step)
+            mgr = self._ckpt_manager()  # detach may have rebuilt the manager
+        try:
+            faults.on_checkpoint_save(step)  # chaos injection (inert: no-op)
+            meta = self._save_meta(epoch)
+            if self._ckpt_async and backup is None:
+                # step-shadow snapshot: the D2H copy is the only blocking
+                # work; the uploader owns durability from here. (Rewrites
+                # stay synchronous — rare, and the reattach guarantee below
+                # wants the save outcome known before the backup is dropped.)
+                host_state = jax.device_get(_unbox(self.state))
+                nbytes = sum(getattr(l, "nbytes", 0)
+                             for l in jax.tree.leaves(host_state))
+                blocking = time.perf_counter() - t0
+                self._obs_ckpt_bytes.set(float(nbytes))
+                self._obs_ckpt_seconds.labels(phase="blocking").observe(blocking)
+                self._upload_thread = threading.Thread(
+                    target=self._upload_snapshot,
+                    args=(mgr, step, host_state, meta, meta_sig,
+                          t0, blocking, nbytes),
+                    name="fleetx-ckpt-upload", daemon=True)
+                self._upload_thread.start()
+                logger.info(
+                    "snapshot of step %d handed to uploader "
+                    "(D2H blocked %.3fs, %.1f MB)",
+                    step, blocking, nbytes / 1e6)
+                return
+            mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(_unbox(self.state)),
+                    meta=ocp.args.JsonSave(meta),
                 ),
-            ),
-        )
+            )
+            if backup is not None:
+                # rewrite: the replacement must be durably finalized before
+                # the old copy stops being the fallback
+                mgr.wait_until_finished()
+        except BaseException:
+            if backup is not None:
+                self._reattach_step(backup, step)
+            raise
+        if backup is not None:
+            import shutil
+            shutil.rmtree(backup, ignore_errors=True)
+        dt = time.perf_counter() - t0
+        nbytes = sum(getattr(l, "nbytes", 0)
+                     for l in jax.tree.leaves(_unbox(self.state)))
+        self._obs_ckpt_bytes.set(float(nbytes))
+        self._obs_ckpt_seconds.labels(phase="blocking").observe(dt)
+        self._obs_ckpt_seconds.labels(phase="total").observe(dt)
+        obs_emit("checkpoint_saved", step=step, mode="sync",
+                 blocking_s=round(dt, 4), total_s=round(dt, 4), bytes=nbytes)
         self._last_saved_meta = meta_sig
         logger.info("saved checkpoint at step %d -> %s", step, self.output_dir)
+
+    def _upload_snapshot(self, mgr, step, host_state, meta, meta_sig,
+                         t0, blocking, nbytes):
+        """Uploader-thread body: feed a host snapshot to the orbax manager.
+        ``_last_saved_meta`` commits only once the write is durably
+        finalized; a failure rides the ``_guarded_save`` counter/event
+        path so chaos assertions see async and sync failures identically."""
+        import orbax.checkpoint as ocp
+
+        try:
+            mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(host_state),
+                    meta=ocp.args.JsonSave(meta),
+                ),
+            )
+            mgr.wait_until_finished()
+            self._last_saved_meta = meta_sig
+            total = time.perf_counter() - t0
+            self._obs_ckpt_seconds.labels(phase="total").observe(total)
+            obs_emit("checkpoint_saved", step=step, mode="async_snapshot",
+                     blocking_s=round(blocking, 4),
+                     total_s=round(total, 4), bytes=nbytes)
+            logger.info(
+                "saved checkpoint at step %d -> %s (async snapshot: "
+                "%.3fs blocking / %.3fs total)",
+                step, self.output_dir, blocking, total)
+        except Exception:
+            self._record_save_failure(step)
+            logger.exception(
+                "async snapshot upload failed at step %d (%d failures so "
+                "far); training continues, next save retries", step,
+                self.save_failures)
+
+    def _detach_step(self, step: int):
+        """Move an existing step directory aside (to
+        ``<output_dir>/rewrite/<step>``) before a meta-advanced rewrite:
+        the detached copy — still a complete, restorable checkpoint —
+        survives any crash or injected fault in the replacement save,
+        and :meth:`_reattach_step` puts it back on failure. One-filesystem
+        renames, so both moves are O(1). Returns the backup path (None
+        when the manager lists the step but no directory exists)."""
+        import shutil
+
+        root = os.path.abspath(os.path.join(self.output_dir, "checkpoints"))
+        src = os.path.join(root, str(step))
+        if not os.path.isdir(src):
+            return None
+        hold = os.path.join(self.output_dir, "rewrite")
+        os.makedirs(hold, exist_ok=True)
+        dst = os.path.join(hold, str(step))
+        if os.path.exists(dst):
+            shutil.rmtree(dst)  # stale leftover from an older crash
+        shutil.move(src, dst)
+        self._mgr_refresh()
+        return dst
+
+    def _reattach_step(self, backup, step: int) -> None:
+        """Restore a detached step directory after a failed rewrite save
+        (drops any partial replacement first — the backup is the good
+        copy)."""
+        import shutil
+
+        if backup is None:
+            return
+        root = os.path.abspath(os.path.join(self.output_dir, "checkpoints"))
+        dst = os.path.join(root, str(step))
+        if os.path.exists(dst):
+            shutil.rmtree(dst)
+        for name in os.listdir(root):
+            if name.startswith(f"{step}.") and "orbax-checkpoint-tmp" in name:
+                shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+        shutil.move(backup, dst)
+        self._mgr_refresh()
+        logger.warning(
+            "rewrite of checkpoint step %d failed; original copy restored",
+            step)
+
+    def _mgr_refresh(self) -> None:
+        """Refresh the manager's cached step list after the directory
+        changed underneath it (quarantine/detach/reattach); falls back to
+        a lazy rebuild on orbax versions without ``reload()``."""
+        mgr = self._ckpt_mgr
+        if mgr is None:
+            return
+        try:
+            mgr.reload()
+        except Exception:  # older orbax: rebuild the manager lazily
+            try:
+                mgr.close()
+            except Exception:
+                pass
+            self._ckpt_mgr = None
 
     def _dropout_impl(self) -> dict:
         from fleetx_tpu.ops.pallas.flash_attention import HW_RNG
@@ -1086,6 +1287,7 @@ class Trainer:
         walking back until one restores (docs/RESILIENCE.md). An explicit
         ``step`` still raises on failure: the caller asked for exactly
         that state, silently substituting another would be worse."""
+        self._join_uploader()  # a pending snapshot upload is a candidate too
         mgr = self._ckpt_manager()
         mgr.wait_until_finished()  # never race our own in-flight async save
         candidates = [step] if step is not None else sorted(
@@ -1109,6 +1311,11 @@ class Trainer:
                     "call init_state (or fit) before load, to build shardings")
             try:
                 restored = self._restore_step(cand)
+            except ElasticMeshMismatch:
+                # a checkpoint written under an incompatible mp/pp/cp
+                # extent is a CONFIG error, not corruption: re-raise
+                # instead of quarantining a healthy checkpoint
+                raise
             except Exception as e:
                 if step is not None:
                     raise
@@ -1134,15 +1341,30 @@ class Trainer:
             "verified restore and was quarantined")
 
     def _restore_step(self, step: int):
-        """Restore + verify one checkpoint step (raises on any mismatch)."""
+        """Restore + verify one checkpoint step (raises on any mismatch).
+
+        The meta JSON is read FIRST and its recorded mesh validated
+        against this trainer's: a dp/fsdp change is the supported elastic
+        reshard (the abstract restore below reshards into THIS mesh's
+        shardings — ZeRO update layouts were re-derived by
+        ``_state_shardings``, never assumed from the writer), while a
+        changed mp/pp/cp extent raises :class:`ElasticMeshMismatch`
+        before the state restore can fail in a way that looks like
+        corruption (``load()`` re-raises it instead of quarantining)."""
         import orbax.checkpoint as ocp
 
+        mgr = self._ckpt_manager()
+        head = mgr.restore(
+            step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))
+        saved_mesh = (head["meta"] or {}).get("mesh")
+        if saved_mesh:
+            validate_restore_mesh(saved_mesh, self.mesh_cfg, step=step)
         abstract = jax.tree.map(
             lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
             _unbox(self.state),
             self._state_sharding_tree,
         )
-        restored = self._ckpt_manager().restore(
+        restored = mgr.restore(
             step,
             args=ocp.args.Composite(
                 state=ocp.args.StandardRestore(abstract),
@@ -1209,15 +1431,7 @@ class Trainer:
             obs_emit("checkpoint_quarantine", step=step, moved_to=dst)
             logger.warning("quarantined corrupt checkpoint %s -> %s",
                            os.path.join(root, name), dst)
-        mgr = self._ckpt_manager()
-        try:
-            mgr.reload()
-        except Exception:  # older orbax: rebuild the manager lazily
-            try:
-                mgr.close()
-            except Exception:
-                pass
-            self._ckpt_mgr = None
+        self._mgr_refresh()
 
     # ------------------------------------------------------------ preemption
     def _install_preemption_handler(self):

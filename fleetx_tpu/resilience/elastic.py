@@ -1,0 +1,213 @@
+"""Elastic training: survive host loss by shrinking the mesh and
+resuming through reshard-on-load.
+
+The serving layer already survives replica death (docs/RESILIENCE.md
+"Router failover"); this module is the training-side counterpart. The
+pieces:
+
+- :func:`validate_restore_mesh` — the reshard-on-load contract.
+  ``Trainer.load()`` restores a checkpoint written under one
+  dp×fsdp×mp mesh onto a *different* mesh: orbax's abstract-shape
+  ``StandardRestore`` reshards into the new trainer's
+  ``_state_shardings`` (ZeRO update layouts re-derived, not assumed)
+  because array *global* shapes do not depend on dp/fsdp extents. They
+  DO depend on mp/pp/cp — vocab padding is sized by the mp degree, and
+  layer stacking by pp — so those extents must match and this function
+  refuses the restore with :class:`ElasticMeshMismatch` (a config
+  error, never quarantined as corruption) when they do not.
+- :func:`plan_shrunken_mesh` — which axis to give up when hosts are
+  lost: dp first (pure replication, cheapest capacity to lose), then
+  fsdp. mp/pp/cp never shrink — the checkpoint contract above.
+- :func:`run_elastic` — the supervisor seam ``tools/train.py`` runs
+  under: catch :class:`~fleetx_tpu.resilience.faults.HostLossFault`
+  from ``Trainer.fit``, take an emergency snapshot if the device state
+  is still reachable, rebuild a smaller mesh, resume via
+  reshard-on-load, and continue — every batch consumed exactly once
+  across the shrink (``consumed_samples`` → sampler continuity).
+
+Chaos coverage: ``tools/chaos_check.py train_elastic`` asserts
+loss-trajectory parity across a mid-run dp4→dp2 shrink against an
+uninterrupted dp2 run over the same batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+from fleetx_tpu.obs.events import emit as obs_emit
+from fleetx_tpu.parallel.mesh import MeshConfig
+from fleetx_tpu.resilience.faults import HostLossFault
+from fleetx_tpu.utils.log import logger
+
+__all__ = [
+    "ElasticMeshMismatch",
+    "apply_mesh_to_config",
+    "plan_shrunken_mesh",
+    "run_elastic",
+    "validate_restore_mesh",
+]
+
+# axes whose extent is baked into array global shapes (vocab padding ~ mp,
+# layer placement ~ pp, sequence split ~ cp): a checkpoint cannot move
+# across a change in any of these, only across dp/fsdp.
+_FIXED_AXES = ("mp", "pp", "cp")
+
+
+class ElasticMeshMismatch(RuntimeError):
+    """A checkpoint cannot be restored onto this mesh (or the mesh cannot
+    shrink): an axis whose extent is baked into array shapes differs.
+    This is a *configuration* error, not checkpoint corruption —
+    ``Trainer.load`` re-raises it instead of quarantining the (healthy)
+    checkpoint."""
+
+
+def validate_restore_mesh(saved: dict, mesh_cfg: MeshConfig,
+                          step: Optional[int] = None) -> None:
+    """Check a checkpoint's recorded mesh against the restoring mesh.
+
+    ``saved`` is the ``meta["mesh"]`` dict the Trainer records at save
+    time (``{"dp": ..., "fsdp": ..., "mp": ..., "pp": ..., "cp": ...}``).
+    mp/pp/cp extents must agree (raises :class:`ElasticMeshMismatch`
+    otherwise); a dp/fsdp change is the supported elastic reshard and
+    just logs + emits an ``elastic_reshard`` event.
+    """
+    bad = {}
+    for ax in _FIXED_AXES:
+        was, now = int(saved.get(ax) or 1), int(getattr(mesh_cfg, ax))
+        if was != now:
+            bad[ax] = (was, now)
+    if bad:
+        detail = ", ".join(f"{ax} {was}->{now}" for ax, (was, now) in bad.items())
+        raise ElasticMeshMismatch(
+            f"checkpoint{'' if step is None else f' step {step}'} was written "
+            f"under an incompatible mesh: {detail} (mp/pp/cp extents are "
+            "baked into array shapes; only dp/fsdp may change on restore)")
+    was_dp = int(saved.get("dp") or 1)
+    was_fsdp = int(saved.get("fsdp") or 1)
+    if (was_dp, was_fsdp) != (mesh_cfg.dp, mesh_cfg.fsdp):
+        logger.info(
+            "elastic reshard-on-load: checkpoint mesh dp%d x fsdp%d -> "
+            "dp%d x fsdp%d (ZeRO update layouts re-derived for the new mesh)",
+            was_dp, was_fsdp, mesh_cfg.dp, mesh_cfg.fsdp)
+        obs_emit("elastic_reshard", step=step,
+                 saved_dp=was_dp, saved_fsdp=was_fsdp,
+                 dp=mesh_cfg.dp, fsdp=mesh_cfg.fsdp)
+
+
+def plan_shrunken_mesh(mesh_cfg: MeshConfig, factor: int = 2) -> MeshConfig:
+    """The mesh to resume on after losing ``1 - 1/factor`` of the hosts.
+
+    Gives up dp capacity first (pure replication — shrinking it costs
+    throughput, nothing else), then fsdp. mp/pp/cp never change: their
+    extents are baked into the checkpoint (see :func:`validate_restore_mesh`),
+    so a job that loses part of a model-parallel group cannot shrink and
+    this raises :class:`ElasticMeshMismatch`.
+    """
+    if mesh_cfg.dp > 1 and mesh_cfg.dp % factor == 0:
+        return dataclasses.replace(mesh_cfg, dp=mesh_cfg.dp // factor)
+    if mesh_cfg.fsdp > 1 and mesh_cfg.fsdp % factor == 0:
+        return dataclasses.replace(mesh_cfg, fsdp=mesh_cfg.fsdp // factor)
+    raise ElasticMeshMismatch(
+        f"mesh dp{mesh_cfg.dp} x fsdp{mesh_cfg.fsdp} x mp{mesh_cfg.mp} x "
+        f"pp{mesh_cfg.pp} x cp{mesh_cfg.cp} has no data-parallel capacity "
+        f"to give up (cannot shrink by {factor}; mp/pp/cp extents are fixed "
+        "by the checkpoint contract)")
+
+
+def apply_mesh_to_config(cfg, new_mesh: MeshConfig) -> None:
+    """Rewrite ``cfg`` in place for a shrunken mesh, holding the
+    optimization trajectory fixed.
+
+    ``Global.global_batch_size`` (and the gradient-accumulation factor
+    ``local/micro``) are preserved by scaling ``local_batch_size`` and
+    ``micro_batch_size`` up by the lost data-parallel capacity — the
+    resumed run applies the *same* global batches in the same order,
+    just spread over fewer replicas. Raises :class:`ElasticMeshMismatch`
+    when the global batch does not divide over the new mesh.
+    """
+    dist = cfg.Distributed
+    old_world = (dist.dp_degree or 1) * ((dist.sharding or {}).get("sharding_degree") or 1)
+    new_world = new_mesh.dp * new_mesh.fsdp
+    glb = cfg.Global
+    gbs = glb.global_batch_size
+    if gbs % new_world:
+        raise ElasticMeshMismatch(
+            f"global_batch_size {gbs} does not divide over the shrunken "
+            f"data-parallel world {new_world} (dp{new_mesh.dp} x fsdp{new_mesh.fsdp})")
+    accum = glb.local_batch_size // glb.micro_batch_size
+    dist.dp_degree = new_mesh.dp
+    dist.sharding.sharding_degree = new_mesh.fsdp
+    glb.local_batch_size = gbs // new_world
+    if glb.local_batch_size % accum:
+        raise ElasticMeshMismatch(
+            f"local_batch_size {glb.local_batch_size} on the shrunken mesh "
+            f"does not preserve the gradient-accumulation factor {accum}")
+    glb.micro_batch_size = glb.local_batch_size // accum
+    logger.info(
+        "elastic config rewrite: dp world %d -> %d, local_batch %d, "
+        "micro_batch %d (global_batch %d held fixed)",
+        old_world, new_world, glb.local_batch_size, glb.micro_batch_size, gbs)
+
+
+def run_elastic(cfg, trainer, train_data, valid_data=None, *,
+                build_trainer: Optional[Callable] = None,
+                make_loader: Optional[Callable] = None,
+                max_shrinks: int = 4):
+    """Run ``trainer.fit`` under the elastic supervisor.
+
+    On :class:`HostLossFault` (the injected stand-in for a host dropping
+    out): take an emergency snapshot if the device state is still
+    reachable (``_guarded_save`` absorbs a failure — resume then falls
+    back to the last periodic checkpoint, re-feeding its batches exactly
+    once), plan a smaller mesh, rewrite ``cfg``, rebuild the trainer,
+    and resume through reshard-on-load. Returns the (possibly rebuilt)
+    trainer after ``fit`` completes.
+
+    ``build_trainer(cfg)`` overrides trainer construction (default:
+    ``Trainer(cfg, build_module(cfg))``); ``make_loader(cfg, consumed)``
+    rebuilds the train iterable for the new mesh given the samples
+    already consumed — without it ``train_data`` is reused as-is, and
+    data-order continuity rides the batch sampler's
+    ``consumed_samples`` when one is attached.
+    """
+    shrinks = 0
+    while True:
+        try:
+            trainer.fit(train_data, valid_data)
+            return trainer
+        except HostLossFault as e:
+            shrinks += 1
+            step = int(trainer.state.step) if trainer.state is not None else -1
+            if shrinks > max_shrinks:
+                logger.error("host loss at step %d but shrink budget "
+                             "(%d) exhausted; giving up", step, max_shrinks)
+                raise
+            logger.warning("host loss at step %d (%s); attempting elastic "
+                           "shrink %d/%d", step, e, shrinks, max_shrinks)
+            # emergency snapshot: in a real host loss the device state may
+            # already be unreachable — _guarded_save counts the failure and
+            # resume falls back to the last periodic checkpoint
+            epoch = getattr(trainer, "_cur_epoch", trainer.start_epoch)
+            trainer._guarded_save(epoch)
+            trainer.wait_for_checkpoints()
+            new_mesh = plan_shrunken_mesh(trainer.mesh_cfg)
+            obs_emit("elastic_shrink", step=step,
+                     dp=trainer.mesh_cfg.dp, fsdp=trainer.mesh_cfg.fsdp,
+                     new_dp=new_mesh.dp, new_fsdp=new_mesh.fsdp)
+            apply_mesh_to_config(cfg, new_mesh)
+            if build_trainer is not None:
+                trainer = build_trainer(cfg)
+            else:
+                from fleetx_tpu.core.engine import Trainer
+                from fleetx_tpu.models import build_module
+                trainer = Trainer(cfg, build_module(cfg))
+            # init_state's resumable branch restores the snapshot through
+            # reshard-on-load (abstract restore into the new mesh's shardings)
+            first = next(iter(train_data))
+            trainer.init_state(first)
+            if make_loader is not None:
+                train_data = make_loader(cfg, trainer.consumed_samples)
+            sampler = getattr(train_data, "batch_sampler", None)
+            if sampler is not None and hasattr(sampler, "consumed_samples"):
+                sampler.consumed_samples = trainer.consumed_samples

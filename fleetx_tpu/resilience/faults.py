@@ -23,6 +23,14 @@ that keeps the injection deterministic under skip/resume):
   sleep before yielding the matching batch (input-pipeline stall).
 - ``FLEETX_FAULT_CKPT_SAVE_STEP``: ``Trainer.save`` raises ``CkptFault``
   at the matching step numbers (full disk / flaky object store).
+- ``FLEETX_FAULT_HOST_LOSS_STEP``: selector over *applied* train step
+  indices (the step about to run, i.e. ``state.step``) — the Trainer's
+  step path raises ``HostLossFault`` before the matching step executes,
+  modeling a host dropping out of the job. Each matching step index
+  fires at most once per configure: a lost host does not die twice, and
+  the elastic supervisor's resumed run (which replays the same step
+  index on a smaller mesh) must survive. docs/RESILIENCE.md "Elastic
+  training" has the recovery contract.
 
 Serving injection points (exercised by the crash-safe serving story,
 docs/RESILIENCE.md; indices count *attempted* device calls, so a
@@ -109,6 +117,7 @@ __all__ = [
     "DataFault",
     "FaultInjector",
     "FaultPlan",
+    "HostLossFault",
     "KVShipFault",
     "PoisonFault",
     "PrefillFault",
@@ -126,6 +135,14 @@ class DataFault(RuntimeError):
 
 class CkptFault(IOError):
     """Injected checkpoint-write failure (FLEETX_FAULT_CKPT_SAVE_STEP)."""
+
+
+class HostLossFault(RuntimeError):
+    """Injected training host loss (FLEETX_FAULT_HOST_LOSS_STEP): a host
+    dropped out of the job before the matching step ran — the device
+    state for its shard is gone and the job cannot continue on the
+    current mesh. The elastic supervisor (resilience/elastic.py) catches
+    this, snapshots what it can, and resumes on a smaller mesh."""
 
 
 class TickFault(RuntimeError):
@@ -215,6 +232,7 @@ class FaultPlan:
     data_slow_batch: Optional[str] = None
     data_slow_s: float = 0.05
     ckpt_save_step: Optional[str] = None
+    host_loss_step: Optional[str] = None
     tick_raise: Optional[str] = None
     prefill_raise: Optional[str] = None
     tick_hang: Optional[str] = None
@@ -248,6 +266,7 @@ class FaultPlan:
             data_slow_batch=env.get("FLEETX_FAULT_DATA_SLOW_BATCH") or None,
             data_slow_s=_float("FLEETX_FAULT_DATA_SLOW_S", 0.05),
             ckpt_save_step=env.get("FLEETX_FAULT_CKPT_SAVE_STEP") or None,
+            host_loss_step=env.get("FLEETX_FAULT_HOST_LOSS_STEP") or None,
             tick_raise=env.get("FLEETX_FAULT_TICK_RAISE") or None,
             prefill_raise=env.get("FLEETX_FAULT_PREFILL_RAISE") or None,
             tick_hang=env.get("FLEETX_FAULT_TICK_HANG") or None,
@@ -263,6 +282,7 @@ class FaultPlan:
         )
         if not (plan.nan_batch or plan.data_raise_batch
                 or plan.data_slow_batch or plan.ckpt_save_step
+                or plan.host_loss_step
                 or plan.tick_raise or plan.prefill_raise or plan.tick_hang
                 or plan.poison_request or plan.replica_kill
                 or plan.probe_flap or plan.kv_ship_raise
@@ -275,6 +295,7 @@ class FaultInjector:
     """Process-global injector: holds the active plan + fetch counters."""
 
     _ZERO = {"nan": 0, "data_raise": 0, "data_slow": 0, "ckpt": 0,
+             "host_loss": 0,
              "tick_raise": 0, "prefill_raise": 0, "tick_hang": 0,
              "poison": 0, "replica_kill": 0, "probe_flap": 0,
              "kv_ship_raise": 0, "kv_ship_corrupt": 0,
@@ -283,6 +304,8 @@ class FaultInjector:
     def __init__(self):
         self._plan: Optional[FaultPlan] = None
         self._nan_sel = self._raise_sel = self._slow_sel = self._ckpt_sel = None
+        self._host_loss_sel = None
+        self._host_loss_fired = set()  # step indices already killed once
         self._tick_sel = self._prefill_sel = self._hang_sel = None
         self._poison_sel = None
         self._ship_raise_sel = self._ship_corrupt_sel = None
@@ -319,6 +342,8 @@ class FaultInjector:
         self._raise_sel = sel("data_raise_batch")
         self._slow_sel = sel("data_slow_batch")
         self._ckpt_sel = sel("ckpt_save_step")
+        self._host_loss_sel = sel("host_loss_step")
+        self._host_loss_fired = set()
         self._tick_sel = sel("tick_raise")
         self._prefill_sel = sel("prefill_raise")
         self._hang_sel = sel("tick_hang")
@@ -401,6 +426,21 @@ class FaultInjector:
             obs_emit("fault_injected", fault="ckpt", step=step)
             raise CkptFault(f"injected checkpoint-write failure at step "
                             f"{step} (FLEETX_FAULT_CKPT_SAVE_STEP)")
+
+    def on_train_step(self, step: int) -> None:
+        """Raise :class:`HostLossFault` before applied step ``step`` runs
+        when it matches the plan. Each matching step index fires at most
+        once per :meth:`configure` — a lost host does not die twice, so
+        the elastic supervisor's resumed run replays the same step index
+        on the shrunken mesh without re-triggering the fault."""
+        if (self._host_loss_sel and step in self._host_loss_sel
+                and step not in self._host_loss_fired):
+            self._host_loss_fired.add(step)
+            self.injected["host_loss"] += 1
+            obs_emit("fault_injected", fault="host_loss", step=step)
+            raise HostLossFault(
+                f"injected host loss before step {step} "
+                "(FLEETX_FAULT_HOST_LOSS_STEP)")
 
     def on_serving_tick(self, tick: int) -> None:
         """Counter-indexed decode-tick faults: hang (sleep) and/or raise

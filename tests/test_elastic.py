@@ -1,0 +1,232 @@
+"""Elastic fault-tolerant training (ISSUE 20): reshard-on-load resume,
+step-shadow snapshot checkpointing, and the host-loss failure domain.
+
+Tier-1 gates:
+
+- reshard-on-load parity: a dp2 checkpoint restored onto a dp1 mesh is
+  byte-identical (params AND opt state) to a same-mesh dp2 restore, with
+  resume meta carried over — the acceptance gate for elastic resume;
+- the mp-extent contract: a checkpoint recorded under a different mp
+  extent refuses to restore with ElasticMeshMismatch and is NEVER
+  quarantined (config error, not corruption);
+- step-shadow snapshot checkpointing (FLEETX_CKPT_ASYNC_SNAPSHOT):
+  periodic saves land through the background uploader with no
+  ``*.orbax-checkpoint-tmp`` debris, resume restores them exactly, the
+  duplicate-step skip still holds, and the blocking/total histogram +
+  bytes gauge + ``checkpoint_saved`` event are populated;
+- host-loss injector semantics (fire-once per step index) and the
+  shrink/config-rewrite planners.
+
+The end-to-end dp4→dp2 host-loss story lives in
+``tools/chaos_check.py train_elastic`` (CLI smoke in test_tools.py,
+slow-marked); these gates keep its building blocks in tier-1."""
+
+import dataclasses
+import glob
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from fleetx_tpu.core.engine import Trainer, _unbox
+from fleetx_tpu.models import build_module
+from fleetx_tpu.obs import get_event_log
+from fleetx_tpu.obs.registry import get_registry
+from fleetx_tpu.parallel.mesh import MeshConfig
+from fleetx_tpu.resilience.elastic import (
+    ElasticMeshMismatch,
+    apply_mesh_to_config,
+    plan_shrunken_mesh,
+    validate_restore_mesh,
+)
+from fleetx_tpu.resilience.faults import HostLossFault, faults
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+sys.path.insert(0, REPO)
+# reuse the chaos CLI's tiny-trainer rig so the suites can't drift
+from tools.chaos_check import _batches, _cfg  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    """Every test starts and ends with an inert injector."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _leaves(tree):
+    return [np.asarray(x) for x in jax.tree.leaves(_unbox(tree))]
+
+
+# ------------------------------------------------------------------- units
+
+def test_validate_restore_mesh_contract():
+    """dp/fsdp may change (emits elastic_reshard); mp/pp/cp may not."""
+    cfg = MeshConfig(dp=2, fsdp=1, mp=1)
+    validate_restore_mesh({"dp": 4, "fsdp": 1, "mp": 1}, cfg)  # ok: reshard
+    assert get_event_log().find("elastic_reshard", saved_dp=4, dp=2)
+    # missing axes default to 1 (old checkpoints without pp/cp rows)
+    validate_restore_mesh({"dp": 2}, cfg)
+    for ax in ("mp", "pp", "cp"):
+        with pytest.raises(ElasticMeshMismatch, match=f"{ax} 2->1"):
+            validate_restore_mesh({"dp": 2, ax: 2}, cfg)
+
+
+def test_plan_shrunken_mesh_prefers_dp():
+    """dp halves first (pure replication), then fsdp; mp/pp/cp never."""
+    assert plan_shrunken_mesh(MeshConfig(dp=4)).dp == 2
+    got = plan_shrunken_mesh(MeshConfig(dp=1, fsdp=4))
+    assert (got.dp, got.fsdp) == (1, 2)
+    got = plan_shrunken_mesh(MeshConfig(dp=2, fsdp=2))
+    assert (got.dp, got.fsdp) == (1, 2)
+    kept = plan_shrunken_mesh(MeshConfig(dp=2, mp=2, sharding_stage=2))
+    assert (kept.mp, kept.sharding_stage) == (2, 2)  # mp + stage preserved
+    with pytest.raises(ElasticMeshMismatch, match="cannot shrink"):
+        plan_shrunken_mesh(MeshConfig(dp=1, fsdp=1, mp=2))
+
+
+def test_apply_mesh_to_config_holds_global_batch(tmp_path):
+    """The config rewrite keeps global_batch_size and the grad-accum
+    factor fixed while halving the data-parallel world."""
+    if jax.device_count() < 2:
+        pytest.skip("needs >=2 devices for a dp mesh")
+    cfg = _cfg(str(tmp_path), "o", nranks=2)
+    gbs = cfg.Global.global_batch_size
+    accum = cfg.Global.local_batch_size // cfg.Global.micro_batch_size
+    apply_mesh_to_config(cfg, plan_shrunken_mesh(MeshConfig(dp=2)))
+    assert cfg.Distributed.dp_degree == 1
+    assert cfg.Global.global_batch_size == gbs
+    assert cfg.Global.local_batch_size == gbs
+    assert cfg.Global.local_batch_size // cfg.Global.micro_batch_size == accum
+
+
+def test_host_loss_fires_once_per_step_index():
+    """FLEETX_FAULT_HOST_LOSS_STEP kills the matching step exactly once:
+    the resumed run replays the same step index without re-dying."""
+    faults.configure(host_loss_step="3")
+    faults.on_train_step(2)  # non-matching: inert
+    with pytest.raises(HostLossFault, match="before step 3"):
+        faults.on_train_step(3)
+    faults.on_train_step(3)  # fired already: the replayed step survives
+    assert faults.injected["host_loss"] == 1
+    assert get_event_log().find("fault_injected", fault="host_loss", step=3)
+    # env plumbing: the var parses into a plan like every other injector
+    from fleetx_tpu.resilience.faults import FaultPlan
+    plan = FaultPlan.from_env({"FLEETX_FAULT_HOST_LOSS_STEP": "2+"})
+    assert plan is not None and plan.host_loss_step == "2+"
+
+
+# --------------------------------------------------- reshard-on-load gates
+
+def test_reshard_on_load_dp2_to_dp1_byte_parity(tmp_path):
+    """Acceptance gate: a dp2 checkpoint (ZeRO update sharding active)
+    restored onto a dp1 mesh is byte-identical — params, opt state, and
+    resume meta — to a same-mesh dp2 restore."""
+    if jax.device_count() < 2:
+        pytest.skip("needs >=2 devices for a dp mesh")
+    cfg2 = _cfg(str(tmp_path / "a"), "o", nranks=2,
+                **{"Engine.max_steps": 3})
+    data = _batches(cfg2, 3)
+    t2 = Trainer(cfg2, build_module(cfg2))
+    t2.fit(data)
+    assert t2._zero_update  # dp2 => ZeRO layouts in the checkpoint
+    t2.save(epoch=0)
+    t2.wait_for_checkpoints()
+
+    cfg1 = _cfg(str(tmp_path / "b"), "o1", nranks=1,
+                **{"Engine.max_steps": 3})
+    cfg1.Engine.save_load.output_dir = cfg2.Engine.save_load.output_dir
+    t1 = Trainer(cfg1, build_module(cfg1))
+    t1.init_state(data[0])  # resumable branch -> reshard-on-load
+    assert int(t1.state.step) == 3
+    assert t1.consumed_samples == t2.consumed_samples
+    assert get_event_log().find("elastic_reshard", saved_dp=2, dp=1)
+
+    # reference: a fresh same-mesh dp2 restore of the same checkpoint
+    t2b = Trainer(cfg2, build_module(cfg2))
+    t2b.init_state(data[0])
+    assert int(t2b.state.step) == 3
+    for a, b in zip(_leaves(t1.state.params), _leaves(t2b.state.params)):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(_leaves(t1.state.opt_state),
+                    _leaves(t2b.state.opt_state)):
+        np.testing.assert_array_equal(a, b)
+    # no quarantine: both restores saw a healthy checkpoint
+    assert not os.path.isdir(os.path.join(
+        cfg2.Engine.save_load.output_dir, "quarantine"))
+
+
+def test_mp_extent_mismatch_refused_not_quarantined(tmp_path):
+    """A checkpoint recorded under a different mp extent raises
+    ElasticMeshMismatch from load() — on the auto-restore path too — and
+    the (healthy) checkpoint is NOT quarantined."""
+    cfg = _cfg(str(tmp_path), "o", **{"Engine.max_steps": 2})
+    data = _batches(cfg, 2)
+    t = Trainer(cfg, build_module(cfg))
+    t.fit(data)
+    # record an mp2 mesh in the checkpoint meta (saving under a real mp2
+    # mesh needs 2 devices and a vocab repad; the validation only reads
+    # the recorded extents, so forging them exercises the same path)
+    t.mesh_cfg = dataclasses.replace(t.mesh_cfg, mp=2)
+    t.save(epoch=0)
+    t.wait_for_checkpoints()
+
+    t2 = Trainer(cfg, build_module(cfg))
+    with pytest.raises(ElasticMeshMismatch, match="mp 2->1"):
+        t2.init_state(data[0])
+    out = cfg.Engine.save_load.output_dir
+    assert not os.path.isdir(os.path.join(out, "quarantine"))
+    assert t2._ckpt_manager().all_steps() == [2]  # still on disk, untouched
+
+
+# ------------------------------------------- step-shadow snapshot (async)
+
+def test_async_snapshot_checkpoint_contracts(tmp_path, monkeypatch):
+    """FLEETX_CKPT_ASYNC_SNAPSHOT: periodic saves land via the background
+    uploader (no *.orbax-checkpoint-tmp debris after
+    wait_for_checkpoints), resume restores them exactly, the
+    duplicate-step skip holds, and the split histogram + bytes gauge +
+    checkpoint_saved event are populated."""
+    monkeypatch.setenv("FLEETX_CKPT_ASYNC_SNAPSHOT", "1")
+    cfg = _cfg(str(tmp_path), "o", **{"Engine.max_steps": 4,
+                                      "Engine.save_load.save_steps": 2})
+    data = _batches(cfg, 4)
+    t = Trainer(cfg, build_module(cfg))
+    t.fit(data)
+    assert t._ckpt_async
+    t.wait_for_checkpoints()
+    assert sorted(t._ckpt_manager().all_steps()) == [2, 4]
+    out = cfg.Engine.save_load.output_dir
+    debris = glob.glob(os.path.join(out, "**", "*orbax-checkpoint-tmp*"),
+                       recursive=True)
+    assert not debris, debris
+    assert t.save_failures == 0
+
+    # duplicate-step skip: same step + same meta must not rewrite
+    before = os.stat(os.path.join(out, "checkpoints", "4")).st_mtime_ns
+    t.save(epoch=0)
+    t.wait_for_checkpoints()
+    assert os.stat(os.path.join(out, "checkpoints", "4")).st_mtime_ns == before
+
+    # resume restores the uploader-written checkpoint byte-exactly
+    t2 = Trainer(cfg, build_module(cfg))
+    t2.init_state(data[0])
+    assert int(t2.state.step) == 4
+    for a, b in zip(_leaves(t.state.params), _leaves(t2.state.params)):
+        np.testing.assert_array_equal(a, b)
+
+    # observability: both phases sampled, bytes gauge set, event banked
+    snap = get_registry().snapshot()
+    hist = {tuple(sorted(s["labels"].items())): s
+            for s in snap["fleetx_ckpt_save_seconds"]["series"]}
+    assert hist[(("phase", "blocking"),)]["count"] >= 2
+    assert hist[(("phase", "total"),)]["count"] >= 2
+    [bytes_series] = snap["fleetx_ckpt_bytes"]["series"]
+    assert bytes_series["value"] > 0
+    evs = get_event_log().find("checkpoint_saved", mode="async_snapshot")
+    assert {e.attrs["step"] for e in evs} >= {2, 4}
+    for e in evs:
+        assert e.attrs["blocking_s"] <= e.attrs["total_s"]

@@ -21,6 +21,21 @@ from fleetx_tpu.obs import (
     unregister_health,
 )
 
+
+@pytest.fixture(autouse=True)
+def _flush_stale_health_probes():
+    """Engines unregister their global /healthz probe via weakref.finalize,
+    i.e. only once gc actually collects them — a draining/dead engine from
+    an earlier test module can linger until then and flip this module's
+    healthz assertions to 503 (same flake class test_serving_api.py guards
+    against). Collect up front so only probes registered by THIS test are
+    live."""
+    import gc
+
+    gc.collect()
+    yield
+
+
 # ------------------------------------------------------------- registry
 
 

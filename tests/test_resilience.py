@@ -139,6 +139,10 @@ def test_sentry_gnorm_spike_threshold(tmp_path, monkeypatch):
     assert t.sentry_skips == 2 and int(t.state.step) == 0
 
 
+@pytest.mark.slow  # ~6s; the quarantine-and-fall-back contract stays
+# tier-1 via test_partial_state_truncation_quarantined below (mid-leaf
+# truncation -> quarantine latest -> restore previous step); this one
+# adds the whole-directory-garbage flavour of the same path
 def test_checkpoint_fallback_quarantines_corrupt_latest(tmp_path):
     """Acceptance (b): a corrupted latest checkpoint (truncated state dir,
     as a kill between async save and finalize leaves) is quarantined and
@@ -214,6 +218,91 @@ def test_raising_data_stream_banks_emergency_checkpoint(tmp_path):
     assert faults.injected["data_slow"] == 1
     assert int(t.state.step) == 2  # two healthy steps before the fault
     assert t._ckpt_manager().latest_step() == 2  # banked before re-raise
+
+
+def test_meta_advanced_rewrite_survives_ckpt_fault(tmp_path):
+    """ISSUE 20 satellite: the meta-advanced rewrite of an existing step
+    must never destroy the only copy. The old flow deleted the step
+    directory BEFORE the replacement save, so a crash (here: an injected
+    CkptFault landing on the rewrite) left nothing restorable; now the
+    old directory is detached first and reattached on failure."""
+    cfg = _tcfg(tmp_path)
+    cfg.Engine.save_load.save_steps = 2
+    cfg.Engine.max_steps = 2
+    t = Trainer(cfg, build_module(cfg))
+    data = _tbatches(cfg, 3)
+    t.fit(data[:2])  # periodic save at step 2
+    t.wait_for_checkpoints()
+    gbs = cfg.Global.global_batch_size
+    assert t.consumed_samples == 2 * gbs
+
+    # advance meta with the step counter frozen (what a sentry skip does),
+    # then let the rewrite save die on an injected fault
+    t.consumed_samples += gbs
+    faults.configure(ckpt_save_step="2")
+    t._guarded_save(0)
+    faults.reset()
+    assert t.save_failures == 1
+
+    # the original step-2 checkpoint must still be on disk and restorable
+    # with the OLD meta (the rewrite never landed)
+    assert t._ckpt_manager().all_steps() == [2]
+    t2 = Trainer(cfg, build_module(cfg))
+    t2.init_state(data[0])
+    assert int(t2.state.step) == 2
+    assert t2.consumed_samples == 2 * gbs
+    assert not os.path.isdir(os.path.join(
+        cfg.Engine.save_load.output_dir, "quarantine"))
+
+    # with the fault cleared the retried rewrite lands the advanced meta
+    t.save(epoch=0)
+    t.wait_for_checkpoints()
+    t3 = Trainer(cfg, build_module(cfg))
+    t3.init_state(data[0])
+    assert int(t3.state.step) == 2
+    assert t3.consumed_samples == 3 * gbs
+    # no backup debris left behind after the successful rewrite
+    assert not os.path.isdir(os.path.join(
+        cfg.Engine.save_load.output_dir, "rewrite", "2"))
+
+
+def test_partial_state_truncation_quarantined(tmp_path):
+    """ISSUE 20 satellite: a checkpoint whose ``state`` payload is
+    truncated MID-LEAF (meta JSON intact — the shape a torn write or
+    partial copy leaves, unlike the whole-subtree deletion covered
+    above) must fail verified restore, be quarantined, and fall back to
+    the prior step."""
+    cfg = _tcfg(tmp_path)
+    cfg.Engine.save_load.save_steps = 2
+    t1 = Trainer(cfg, build_module(cfg))
+    data = _tbatches(cfg, 4)
+    t1.fit(data)  # periodic saves at steps 2 and 4
+    t1.wait_for_checkpoints()
+    root = os.path.join(cfg.Engine.save_load.output_dir, "checkpoints")
+
+    # truncate the largest file under step 4's state subtree to half
+    state_dirs = [os.path.join(root, "4", n)
+                  for n in os.listdir(os.path.join(root, "4"))
+                  if "state" in n]
+    assert state_dirs
+    victim, vsize = None, 0
+    for d, _, files in os.walk(state_dirs[0]):
+        for f in files:
+            p = os.path.join(d, f)
+            if os.path.getsize(p) > vsize:
+                victim, vsize = p, os.path.getsize(p)
+    assert victim is not None and vsize > 0
+    with open(victim, "r+b") as f:
+        f.truncate(vsize // 2)
+    # meta stays intact
+    assert any("meta" in n for n in os.listdir(os.path.join(root, "4")))
+
+    t2 = Trainer(cfg, build_module(cfg))
+    t2.init_state(data[0])
+    assert int(t2.state.step) == 2  # fell back past the torn step 4
+    qdir = os.path.join(cfg.Engine.save_load.output_dir, "quarantine")
+    assert any(n.isdigit() and int(n) == 4 for n in os.listdir(qdir))
+    assert 4 not in t2._ckpt_manager().all_steps()
 
 
 # ------------------------------------------------------------- serving side

@@ -478,6 +478,25 @@ def test_chaos_check_unknown_scenario_fails(tmp_path):
     assert cc.main(["--only", "nope", "--workdir", str(tmp_path)]) == 1
 
 
+@pytest.mark.slow  # ~30s (3 tiny trainer compiles); the contracts are
+def test_chaos_check_train_elastic_scenario(tmp_path, capsys):
+    # tier-1 via tests/test_elastic.py (dp2->dp1 reshard byte parity,
+    # async snapshot contracts, host-loss injector) and
+    # tests/test_resilience.py; this proves the dp4->dp2 host-loss story
+    # end-to-end through the CLI driver
+    """The elastic-training chaos scenario (host loss at step 3 ->
+    emergency snapshot -> dp4->dp2 shrink -> reshard-on-load resume with
+    post-shrink loss parity vs an uninterrupted dp2 run) passes through
+    the CLI driver."""
+    sys.path.insert(0, REPO)
+    import tools.chaos_check as cc
+
+    rc = cc.main(["--only", "train_elastic", "--workdir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "PASS train_elastic" in out
+
+
 @pytest.mark.slow  # 75.2s baseline (PR 12 tier-1 budget audit): every
 def test_chaos_check_serving_recovery_scenarios(tmp_path, capsys):
     # contract here is tier-1 via tests/test_serving_recovery.py; this

@@ -71,7 +71,14 @@ per scenario, non-zero exit on any failure:
   of both families reaches exactly one terminal result, migrated GPT
   streams are byte-identical to a clean single replica, embedding bits
   match a lone-engine reference, and dispatch never crosses model
-  families (asserted on every prompt each engine ever saw).
+  families (asserted on every prompt each engine ever saw);
+- ``train_elastic``: a dp4 training run LOSES A HOST at step 3
+  (``FLEETX_FAULT_HOST_LOSS_STEP``): the elastic supervisor takes an
+  emergency snapshot, shrinks the mesh dp4→dp2 (global batch held
+  fixed), resumes through reshard-on-load, and the applied-loss
+  trajectory over the post-shrink batches matches an uninterrupted dp2
+  run — every batch consumed exactly once, none re-fed or skipped
+  (skips gracefully below 4 devices).
 
 Usage::
 
@@ -1189,6 +1196,106 @@ def scenario_serving_qos(tmp):
             "streams byte-identical, shed confined to the flood lane")
 
 
+def scenario_train_elastic(tmp):
+    """Host loss mid-training -> elastic shrink -> reshard-on-load parity.
+
+    A dp4 run (global batch 8) loses a host before step 3 runs
+    (``FLEETX_FAULT_HOST_LOSS_STEP=3``); the elastic supervisor
+    (resilience/elastic.py) snapshots at step 3, shrinks the mesh to dp2
+    with the global batch held fixed (local batch 2 -> 4), resumes
+    through reshard-on-load, and finishes the run. The applied-loss
+    trajectory over the post-shrink batches must match an uninterrupted
+    dp2 run over the same 6 batches at tight fp32 atol (dp4 vs dp2
+    differ only in reduction order; FLEETX_THREEFRY_PARTITIONABLE makes
+    init mesh-independent), with every batch consumed exactly once —
+    the aborted step's batch is re-fed once, nothing else re-fed or
+    skipped."""
+    import jax
+    import numpy as np
+
+    from fleetx_tpu.obs import get_event_log
+    from fleetx_tpu.resilience.faults import faults
+
+    if jax.device_count() < 4:
+        return ("skipped: needs >=4 devices for the dp4 mesh (run with "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    from fleetx_tpu.core.engine import Trainer
+    from fleetx_tpu.models import build_module
+    from fleetx_tpu.resilience.elastic import run_elastic
+    from fleetx_tpu.utils.config import get_config
+
+    STEPS, GBS = 6, 8
+
+    def cfg_for(name, nranks, local_batch):
+        # the shared _cfg rig bakes local_batch_size=2; the dp2 runs here
+        # need local_batch 4 so every mesh sees the SAME global batch of 8
+        d = os.path.join(tmp, name + "_cfg")
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, "cfg.yaml")
+        text = _TRAIN_YAML.replace(
+            "local_batch_size: 2", f"local_batch_size: {local_batch}"
+        ).replace("micro_batch_size: 2", f"micro_batch_size: {local_batch}")
+        with open(path, "w") as f:
+            f.write(text)
+        cfg = get_config(path, nranks=nranks)
+        cfg.Engine.max_steps = STEPS
+        cfg.Engine.logging_freq = 1  # per-step loss capture
+        cfg.Engine.save_load.output_dir = os.path.join(tmp, name)
+        return cfg
+
+    def recording_trainer(cfg, sink):
+        module = build_module(cfg)
+        module.training_step_end = lambda log: sink.append(float(log["loss"]))
+        return Trainer(cfg, module)
+
+    cfg_ref = cfg_for("ref", nranks=2, local_batch=4)
+    data = _batches(cfg_ref, STEPS)
+    assert cfg_ref.Global.global_batch_size == GBS
+
+    ref_losses = []
+    ref = recording_trainer(cfg_ref, ref_losses)
+    ref.fit(data)
+    assert len(ref_losses) == STEPS
+
+    cfg_el = cfg_for("elastic", nranks=4, local_batch=2)
+    assert cfg_el.Global.global_batch_size == GBS
+    el_losses = []
+    faults.configure(host_loss_step="3")
+    try:
+        t = run_elastic(
+            cfg_el, recording_trainer(cfg_el, el_losses), data,
+            build_trainer=lambda c: recording_trainer(c, el_losses),
+            make_loader=lambda c, consumed: data[consumed // GBS:])
+        injected = dict(faults.injected)
+    finally:
+        faults.reset()
+
+    assert injected["host_loss"] == 1, injected
+    assert t.mesh_cfg.dp == 2, f"mesh did not shrink: dp{t.mesh_cfg.dp}"
+    assert int(t.state.step) == STEPS, int(t.state.step)
+    # exactly-once accounting: 6 batches x 8 samples, no re-feed/skip
+    assert t.consumed_samples == STEPS * GBS, t.consumed_samples
+    assert t.sentry_skips == 0
+    assert len(el_losses) == STEPS, el_losses
+    assert t._restored_step == 3, t._restored_step
+    # post-shrink trajectory parity vs the uninterrupted dp2 run (tight
+    # fp32 atol: same batches, same order, same global batch)
+    np.testing.assert_allclose(el_losses[3:], ref_losses[3:], atol=2e-5,
+                               rtol=0)
+    # pre-shrink dp4 steps see the same batches too (reduction order is
+    # the only difference)
+    np.testing.assert_allclose(el_losses[:3], ref_losses[:3], atol=2e-5,
+                               rtol=0)
+    ev = get_event_log()
+    assert ev.find("fault_injected", fault="host_loss")
+    assert ev.find("elastic_shrink")
+    assert ev.find("elastic_reshard")
+    assert ev.find("checkpoint_saved", step=3)
+    return ("host lost at step 3: snapshot -> dp4->dp2 reshard-on-load -> "
+            "loss trajectory matches uninterrupted dp2 (6/6 batches "
+            "consumed exactly once)")
+
+
 SCENARIOS = {
     "sentry": scenario_sentry,
     "sentry_zero": scenario_sentry_zero,
@@ -1207,6 +1314,7 @@ SCENARIOS = {
     "serving_http": scenario_serving_http,
     "serving_hetero": scenario_serving_hetero,
     "serving_qos": scenario_serving_qos,
+    "train_elastic": scenario_train_elastic,
 }
 
 

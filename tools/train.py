@@ -19,6 +19,7 @@ from fleetx_tpu.core.engine import Trainer
 from fleetx_tpu.data import build_dataloader
 from fleetx_tpu.models import build_module
 from fleetx_tpu.parallel.env import init_dist_env
+from fleetx_tpu.resilience.elastic import run_elastic
 from fleetx_tpu.utils.config import get_config, parse_args
 from fleetx_tpu.utils.log import advertise, logger
 
@@ -45,7 +46,12 @@ def main():
         # instead of silently retraining from step 0
         trainer.load()
         train_loader.batch_sampler.consumed_samples = trainer.consumed_samples
-    trainer.fit(train_loader, eval_loader)
+    # elastic supervisor seam (resilience/elastic.py): a HostLossFault
+    # mid-fit triggers emergency snapshot -> smaller mesh -> reshard-on-load
+    # resume; with no fault plan active this is exactly trainer.fit()
+    trainer = run_elastic(
+        cfg, trainer, train_loader, eval_loader,
+        make_loader=lambda c, consumed: build_dataloader(c, "Train"))
     logger.info("training done at step %d", int(trainer.state.step))
 
 
